@@ -1,0 +1,77 @@
+"""SwarmState pytree: construction, coverage metric, slot hashing, checkpointing."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm, message_slot
+from tpu_gossip.core.topology import build_csr, configuration_model, powerlaw_degree_sequence
+
+
+def small_graph(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_csr(n, configuration_model(powerlaw_degree_sequence(n, rng=rng), rng=rng))
+
+
+def test_init_swarm_shapes_and_origin():
+    g = small_graph(100)
+    cfg = SwarmConfig(n_peers=100, msg_slots=8)
+    st = init_swarm(g, cfg, origins=[0, 3], origin_slot=2)
+    assert st.seen.shape == (100, 8)
+    assert bool(st.seen[0, 2]) and bool(st.seen[3, 2])
+    assert int(st.seen.sum()) == 2
+    assert st.n_peers == 100
+    assert int(st.infected_round[0]) == 0 and int(st.infected_round[1]) == -1
+
+
+def test_state_is_pytree():
+    g = small_graph(50)
+    st = init_swarm(g, SwarmConfig(n_peers=50), origins=[0])
+    leaves = jax.tree_util.tree_leaves(st)
+    assert len(leaves) == len(dataclasses.fields(SwarmState))
+    # jit through the pytree
+    f = jax.jit(lambda s: s.seen.sum())
+    assert int(f(st)) == 1
+
+
+def test_coverage_counts_only_live_peers():
+    g = small_graph(10)
+    st = init_swarm(g, SwarmConfig(n_peers=10), origins=list(range(5)))
+    assert float(st.coverage()) == pytest.approx(0.5)
+    st2 = dataclasses.replace(st, alive=jnp.arange(10) < 5)  # only infected ones alive
+    assert float(st2.coverage()) == pytest.approx(1.0)
+
+
+def test_message_slot_stable_and_in_range():
+    assert message_slot("2025-01-01 00:00:00:127.0.0.1:1", 64) == message_slot(
+        "2025-01-01 00:00:00:127.0.0.1:1", 64
+    )
+    slots = {message_slot(f"msg-{i}", 64) for i in range(200)}
+    assert all(0 <= s < 64 for s in slots)
+    assert len(slots) > 32  # spreads over slots
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """SURVEY.md §5.4: checkpoint/resume is pytree serialization."""
+    from tpu_gossip.core.state import load_swarm, save_swarm
+
+    g = small_graph(64)
+    st = init_swarm(g, SwarmConfig(n_peers=64), origins=[1])
+    save_swarm(tmp_path / "ckpt.npz", st)
+    st2 = load_swarm(tmp_path / "ckpt.npz")
+    assert bool(jnp.array_equal(st2.seen, st.seen))
+    assert bool(jnp.array_equal(st2.col_idx, st.col_idx))
+    assert bool(jnp.array_equal(jax.random.key_data(st2.rng), jax.random.key_data(st.rng)))
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SwarmConfig(n_peers=0)
+    with pytest.raises(ValueError):
+        SwarmConfig(n_peers=10, msg_slots=0)
+    g = small_graph(50)
+    with pytest.raises(ValueError):
+        init_swarm(g, SwarmConfig(n_peers=49))

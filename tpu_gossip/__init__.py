@@ -1,0 +1,46 @@
+"""tpu_gossip — a TPU-native framework for gossip protocols on power-law networks.
+
+Built from scratch in JAX/XLA/Pallas with the capability surface of the
+reference `Sidharthshanu/Gossip-protocol-with-power-law` (see SURVEY.md):
+
+- power-law (preferential-attachment) topology construction
+  (reference intent: Seed.py:151-185, demonstrate_powerlaw.py:5-39)
+- seed-based bootstrap / membership (Seed.py:240-299)
+- push-gossip dissemination, generalized to real epidemic flooding with
+  hash-based dedup (reference one-hop broadcast: Peer.py:395-408)
+- heartbeat/timeout liveness + dead-node detection and purge
+  (Peer.py:298-393, Seed.py:358-406)
+- fault injection: silent peers (Peer.py:437-439), churn, SIR dynamics
+- socket-compatible transport preserving the reference wire protocol
+  (SURVEY.md §2.4) behind a ``transport="socket" | "tpu-sim"`` flag.
+
+Instead of one OS process + thread-per-connection per node, the whole swarm
+lives on the TPU as a pytree of arrays (CSR adjacency in HBM, infection /
+liveness masks), one gossip round is a batched gather/scatter over all peers
+at once, and multi-chip runs shard the peer axis 1-D over a
+``jax.sharding.Mesh``.
+"""
+
+from tpu_gossip.core.topology import (
+    Graph,
+    powerlaw_degree_sequence,
+    configuration_model,
+    preferential_attachment,
+    build_csr,
+    fit_powerlaw_gamma,
+)
+from tpu_gossip.core.state import SwarmState, SwarmConfig, init_swarm
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Graph",
+    "powerlaw_degree_sequence",
+    "configuration_model",
+    "preferential_attachment",
+    "build_csr",
+    "fit_powerlaw_gamma",
+    "SwarmState",
+    "SwarmConfig",
+    "init_swarm",
+]

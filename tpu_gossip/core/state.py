@@ -1,0 +1,193 @@
+"""SwarmState: the whole gossip network as one pytree of device arrays.
+
+The reference scatters swarm state across OS processes: per-peer dicts of
+sockets and timestamps (``peer_connections``, ``last_heartbeat`` maps,
+reference Peer.py:12-38) and per-seed registries/topology (reference
+Seed.py:56-76). Here the entire N-peer swarm is a single pytree of jnp
+arrays, 1-D shardable on the peer axis, so a protocol round is a batched
+array program rather than thread-per-connection I/O — and checkpoint/resume
+(absent in the reference, SURVEY.md §5.4) is just serializing the pytree.
+
+State fields mirror the reference's per-node state machine:
+
+- ``seen``/``forwarded``: hash-slot dedup bitmap per peer — the "seen
+  message" capability the reference lacks (incoming gossip is only logged,
+  Peer.py:286,206; BASELINE.json's north star requires hash-based dedup).
+- ``alive``/``silent``: crash vs. silent-fault masks (operator "1" silent
+  mode, Peer.py:437-439, vectorized).
+- ``last_hb``: last round a peer emitted a heartbeat (Peer.py:365-393's
+  15 s cadence, in rounds).
+- ``declared_dead``: the failure detector's output (Peer.py:298-363), which
+  masks the peer out of the topology like the seeds' registry purge
+  (Seed.py:358-406).
+- ``recovered``: SIR epidemic mode (BASELINE.json config 4).
+
+Timing is round-based: 1 round = ``SwarmConfig.round_seconds`` (default 5 s,
+the reference's gossip tick, Peer.py:396-408). The reference's wall-clock
+constants (SURVEY.md §2.5) map to: heartbeat every 3 rounds (15 s), stale
+after 6 rounds (30 s) ≈ "3 missed heartbeats" per BASELINE config 2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.topology import Graph
+
+__all__ = [
+    "SwarmConfig",
+    "SwarmState",
+    "init_swarm",
+    "message_slot",
+    "save_swarm",
+    "load_swarm",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SwarmConfig:
+    """Static protocol parameters (hashable: safe as a jit static argument).
+
+    Defaults reproduce the reference's timing contract (SURVEY.md §2.5)
+    under the 1-round = 5 s mapping.
+    """
+
+    n_peers: int
+    msg_slots: int = 64  # hash-dedup slots (bloom-like; exact when #msgs <= slots)
+    fanout: int = 3  # neighbors pushed per round (subset size, Seed.py:127-129)
+    hb_period_rounds: int = 3  # 15 s heartbeat (Peer.py:393)
+    timeout_rounds: int = 6  # 30 s stale threshold (Peer.py:299)
+    detect_period_rounds: int = 2  # 10 s detector sweep (Peer.py:363)
+    round_seconds: float = 5.0  # gossip tick (Peer.py:396-408)
+    forward_once: bool = False  # True: relay a message only on first receipt
+    sir_recover_rounds: int = 0  # >0 enables SIR: recover this many rounds after infection
+
+    def __post_init__(self):
+        if self.n_peers <= 0:
+            raise ValueError("n_peers must be positive")
+        if self.msg_slots <= 0:
+            raise ValueError("msg_slots must be positive")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SwarmState:
+    """One pytree holding the entire swarm. Shapes: N peers, D = 2E edges, M slots."""
+
+    # topology (CSR, both edge directions)
+    row_ptr: jax.Array  # int32 (N+1,)
+    col_idx: jax.Array  # int32 (D,)
+    # dissemination
+    seen: jax.Array  # bool (N, M) — hash-slot dedup bitmap
+    forwarded: jax.Array  # bool (N, M) — already relayed (forward-once mode)
+    infected_round: jax.Array  # int32 (N,) — round of first infection (SIR; -1 = never)
+    recovered: jax.Array  # bool (N,) — SIR removed state
+    # liveness
+    alive: jax.Array  # bool (N,) — crashed/departed = False
+    silent: jax.Array  # bool (N,) — fault injection: no heartbeats / PING replies
+    last_hb: jax.Array  # int32 (N,) — round of last emitted heartbeat
+    declared_dead: jax.Array  # bool (N,) — failure-detector verdict (registry purge)
+    # bookkeeping
+    rng: jax.Array  # PRNG key
+    round: jax.Array  # int32 scalar
+
+    @property
+    def n_peers(self) -> int:
+        return int(self.row_ptr.shape[0]) - 1
+
+    def coverage(self, slot: int = 0) -> jax.Array:
+        """Fraction of alive peers that have seen message ``slot``."""
+        live = self.alive & ~self.declared_dead
+        n_live = jnp.maximum(jnp.sum(live), 1)
+        return jnp.sum(self.seen[:, slot] & live) / n_live
+
+
+def save_swarm(path, state: SwarmState) -> None:
+    """Checkpoint the swarm (reference has none — SURVEY.md §5.4; the whole
+    simulation state is one pytree, so resume is lossless)."""
+    flat, _ = jax.tree_util.tree_flatten(state)
+    arrays = {}
+    for i, leaf in enumerate(flat):
+        if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            arrays[f"key_{i}"] = np.asarray(jax.random.key_data(leaf))
+        else:
+            arrays[f"arr_{i}"] = np.asarray(leaf)
+    np.savez(path, **arrays)
+
+
+def load_swarm(path) -> SwarmState:
+    """Restore a :func:`save_swarm` checkpoint."""
+    data = np.load(path)
+    _, treedef = jax.tree_util.tree_flatten(_template())
+    leaves = []
+    for i in range(len(dataclasses.fields(SwarmState))):
+        if f"key_{i}" in data:
+            leaves.append(jax.random.wrap_key_data(jnp.asarray(data[f"key_{i}"])))
+        else:
+            leaves.append(jnp.asarray(data[f"arr_{i}"]))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _template() -> SwarmState:
+    """Minimal state used only for its treedef (field order)."""
+    z = jnp.zeros((1,), dtype=jnp.int32)
+    b = jnp.zeros((1,), dtype=bool)
+    return SwarmState(
+        row_ptr=z, col_idx=z, seen=b[None], forwarded=b[None],
+        infected_round=z, recovered=b, alive=b, silent=b, last_hb=z,
+        declared_dead=b, rng=jax.random.key(0), round=jnp.asarray(0, jnp.int32),
+    )
+
+
+def message_slot(message_id: int | str, msg_slots: int) -> int:
+    """Map a message identity to its dedup slot (the "hash-based dedup" hash).
+
+    Stable across runs (unlike Python's salted ``hash``) so socket-mode and
+    tpu-sim runs agree on slots for conformance tests.
+    """
+    if isinstance(message_id, str):
+        h = 2166136261
+        for b in message_id.encode():
+            h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    else:
+        h = (int(message_id) * 2654435761) & 0xFFFFFFFF
+    return h % msg_slots
+
+
+def init_swarm(
+    graph: Graph,
+    config: SwarmConfig,
+    *,
+    key: jax.Array | None = None,
+    origins: np.ndarray | list[int] | None = None,
+    origin_slot: int = 0,
+) -> SwarmState:
+    """Build device state from a host graph; optionally infect ``origins`` in ``origin_slot``."""
+    if graph.n != config.n_peers:
+        raise ValueError(f"graph has {graph.n} nodes but config.n_peers={config.n_peers}")
+    if key is None:
+        key = jax.random.key(0)
+    n, m = config.n_peers, config.msg_slots
+    seen = np.zeros((n, m), dtype=bool)
+    infected_round = np.full((n,), -1, dtype=np.int32)
+    if origins is not None:
+        seen[np.asarray(origins), origin_slot] = True
+        infected_round[np.asarray(origins)] = 0
+    return SwarmState(
+        row_ptr=jnp.asarray(graph.row_ptr, dtype=jnp.int32),
+        col_idx=jnp.asarray(graph.col_idx, dtype=jnp.int32),
+        seen=jnp.asarray(seen),
+        forwarded=jnp.zeros((n, m), dtype=bool),
+        infected_round=jnp.asarray(infected_round),
+        recovered=jnp.zeros((n,), dtype=bool),
+        alive=jnp.ones((n,), dtype=bool),
+        silent=jnp.zeros((n,), dtype=bool),
+        last_hb=jnp.zeros((n,), dtype=jnp.int32),
+        declared_dead=jnp.zeros((n,), dtype=bool),
+        rng=key,
+        round=jnp.asarray(0, dtype=jnp.int32),
+    )

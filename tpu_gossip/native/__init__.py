@@ -1,0 +1,55 @@
+"""Native (C++) fast paths for host-side setup work.
+
+The reference has zero native components (SURVEY.md §2, 100% Python); this
+package exists because the TPU build moves graph *construction* to the host
+critical path at much larger N (1M-10M nodes), where the inherently
+sequential preferential-attachment loop is worth a C++ implementation.
+
+``pa_edges_native`` loads ``libtpugossip.so`` (built by ``build.sh`` /
+``make -C tpu_gossip/native``) via ctypes and returns preferential-attachment
+edges; returns None when the library is absent so callers fall back to numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libtpugossip.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None and os.path.exists(_LIB_PATH):
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.pa_edges.argtypes = [
+            ctypes.c_int64,  # n
+            ctypes.c_int64,  # m
+            ctypes.c_uint64,  # seed
+            ctypes.POINTER(ctypes.c_int64),  # out edges (2 * capacity)
+            ctypes.c_int64,  # capacity (edge pairs)
+        ]
+        lib.pa_edges.restype = ctypes.c_int64  # number of edges written, <0 on error
+        _lib = lib
+    return _lib
+
+
+def pa_edges_native(n: int, m: int, seed: int = 0) -> np.ndarray | None:
+    """C++ Barabási–Albert generator; (E,2) int64 edges or None if lib missing."""
+    lib = _load()
+    if lib is None:
+        return None
+    cap = m * (m + 1) // 2 + (n - m - 1) * m + 16
+    out = np.empty((cap, 2), dtype=np.int64)
+    wrote = lib.pa_edges(
+        n, m, seed, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap
+    )
+    if wrote < 0:
+        raise RuntimeError(f"pa_edges failed with code {wrote}")
+    e = out[:wrote]
+    lo = np.minimum(e[:, 0], e[:, 1])
+    hi = np.maximum(e[:, 0], e[:, 1])
+    return np.unique(np.stack([lo, hi], axis=1), axis=0)
