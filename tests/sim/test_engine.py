@@ -1,0 +1,144 @@
+"""Engine tests: dissemination curves, liveness state machine, SIR, churn
+(SURVEY.md §4 'simulation/integration' tier — deterministic, CPU-only)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+from tpu_gossip.sim.engine import gossip_round, run_until_coverage, simulate
+
+N = 512
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return build_csr(N, preferential_attachment(N, m=3, use_native=False))
+
+
+def make(graph, **kw):
+    cfg = SwarmConfig(n_peers=N, msg_slots=8, **kw)
+    return cfg, init_swarm(graph, cfg, origins=[0])
+
+
+def test_push_reaches_full_coverage(graph):
+    cfg, st = make(graph)
+    fin, stats = simulate(st, cfg, 25)
+    cov = np.asarray(stats.coverage)
+    assert cov[-1] >= 0.99
+    # epidemic growth: coverage is monotone non-decreasing without churn/SIR
+    assert np.all(np.diff(cov) >= -1e-6)
+
+
+def test_flood_covers_in_diameter_rounds(graph):
+    cfg, st = make(graph, mode="flood")
+    _, stats = simulate(st, cfg, 8)
+    # flooding a BA graph (diameter ~ log N) must cover almost immediately
+    assert float(stats.coverage[4]) == 1.0
+
+
+def test_push_pull_faster_than_push(graph):
+    cfg_p, st_p = make(graph)
+    cfg_pp, st_pp = make(graph, mode="push_pull")
+    r_p = int(run_until_coverage(st_p, cfg_p, 0.99, 100).round)
+    r_pp = int(run_until_coverage(st_pp, cfg_pp, 0.99, 100).round)
+    assert r_pp <= r_p
+
+
+def test_run_until_coverage_matches_scan_curve(graph):
+    cfg, st = make(graph)
+    fin = run_until_coverage(st, cfg, 0.99, 100)
+    rounds = int(fin.round)
+    _, stats = simulate(st, cfg, rounds)
+    cov = np.asarray(stats.coverage)
+    assert cov[rounds - 1] >= 0.99
+    assert rounds < 2 or cov[rounds - 2] < 0.99
+
+
+def test_determinism(graph):
+    cfg, st = make(graph)
+    a, sa = simulate(st, cfg, 10)
+    b, sb = simulate(st, cfg, 10)
+    np.testing.assert_array_equal(np.asarray(a.seen), np.asarray(b.seen))
+    np.testing.assert_array_equal(np.asarray(sa.coverage), np.asarray(sb.coverage))
+
+
+def test_dedup_no_reinfection(graph):
+    """Hash-slot dedup: a seen bit never unsets, and infected_round is latched."""
+    cfg, st = make(graph)
+    mid, _ = simulate(st, cfg, 5)
+    fin, _ = simulate(mid, cfg, 5)
+    m_seen = np.asarray(mid.seen)
+    f_seen = np.asarray(fin.seen)
+    assert np.all(f_seen[m_seen])  # no bit lost
+    ir_mid = np.asarray(mid.infected_round)
+    ir_fin = np.asarray(fin.infected_round)
+    assert np.all(ir_fin[ir_mid >= 0] == ir_mid[ir_mid >= 0])  # latched
+
+
+def test_forward_once_spreads_then_stops(graph):
+    """Relay-once mode: dissemination still spreads widely, and message
+    complexity is bounded — once every holder has relayed, sends cease."""
+    cfg, st = make(graph, forward_once=True, fanout=4)
+    _, stats = simulate(st, cfg, 40)
+    assert float(stats.coverage[-1]) >= 0.7
+    msgs = np.asarray(stats.msgs_sent)
+    assert msgs[-1] == 0  # everyone forwarded already; no chatter forever
+    assert msgs.sum() < 4 * N  # ≤ fanout sends per peer total
+
+
+def test_silent_peer_declared_dead_on_schedule(graph):
+    """Silent peers (fault injection, Peer.py:437-439) must be declared dead at
+    the first detector sweep after the stale threshold: timeout 6 rounds +
+    sweep every 2 ⇒ round 8 (the reference's 30-42 s worst case, §6)."""
+    cfg, st = make(graph)
+    st.silent = st.silent.at[:50].set(True)
+    _, stats = simulate(st, cfg, 12)
+    dead = np.asarray(stats.n_declared_dead)
+    assert dead[6] == 0  # not yet stale at round 7 sweep boundary
+    assert dead[7] == 50  # declared at round 8 sweep
+    assert dead[-1] == 50  # no false positives ever
+
+
+def test_healthy_peers_never_declared_dead(graph):
+    cfg, st = make(graph)
+    _, stats = simulate(st, cfg, 30)
+    assert int(stats.n_declared_dead[-1]) == 0
+
+
+def test_crashed_peers_detected_and_excluded(graph):
+    cfg, st = make(graph)
+    st.alive = st.alive.at[100:200].set(False)  # keep origin (peer 0) alive
+    fin, stats = simulate(st, cfg, 15)
+    assert int(stats.n_declared_dead[-1]) == 100
+    # coverage is over live peers only, so it can still reach ~1
+    assert float(stats.coverage[-1]) >= 0.95
+
+
+def test_sir_recovery_halts_transmission(graph):
+    cfg, st = make(graph, sir_recover_rounds=1, fanout=1)
+    fin, stats = simulate(st, cfg, 50)
+    # 1-round infectious period with fanout 1 on a sparse graph: epidemic
+    # dies out well below full coverage
+    assert float(stats.coverage[-1]) < 0.9
+    rec = np.asarray(fin.recovered)
+    seen = np.asarray(fin.seen).any(-1)
+    assert rec.sum() > 0
+    assert np.all(seen[rec])  # only infected peers recover
+
+
+def test_churn_join_resets_state(graph):
+    cfg, st = make(graph, churn_leave_prob=0.05, churn_join_prob=0.2)
+    fin, stats = simulate(st, cfg, 20)
+    alive = np.asarray(stats.n_alive)
+    assert alive.min() < N  # some departures happened
+    assert float(stats.coverage[-1]) > 0.5  # gossip survives churn
+
+
+def test_round_counter_and_rng_advance(graph):
+    cfg, st = make(graph)
+    nxt, _ = gossip_round(st, cfg)
+    assert int(nxt.round) == 1
+    assert not np.array_equal(
+        jax.random.key_data(nxt.rng), jax.random.key_data(st.rng)
+    )

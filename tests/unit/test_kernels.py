@@ -1,0 +1,111 @@
+"""Unit tests for the gossip/liveness ops against tiny hand-checked graphs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.topology import build_csr
+from tpu_gossip.kernels.gossip import (
+    edge_sources,
+    flood_all,
+    pull_fanout,
+    push_fanout,
+    sample_fanout_targets,
+)
+from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
+
+
+def path_graph(n):
+    edges = np.stack([np.arange(n - 1), np.arange(1, n)], axis=1)
+    return build_csr(n, edges)
+
+
+def test_edge_sources_matches_csr_rows():
+    g = path_graph(4)  # degrees 1,2,2,1
+    src = np.asarray(edge_sources(jnp.asarray(g.row_ptr), g.col_idx.shape[0]))
+    expect = np.repeat(np.arange(4), g.degrees)
+    np.testing.assert_array_equal(src, expect)
+
+
+def test_flood_all_one_hop_exact():
+    g = path_graph(5)
+    transmit = jnp.zeros((5, 2), dtype=bool).at[2, 0].set(True)
+    out = np.asarray(flood_all(transmit, jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx)))
+    # only the path-neighbors of node 2 receive slot 0
+    np.testing.assert_array_equal(out[:, 0], [False, True, False, True, False])
+    assert not out[:, 1].any()
+
+
+def test_sample_targets_are_neighbors():
+    g = path_graph(16)
+    tgt, valid = sample_fanout_targets(
+        jax.random.key(0), jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx), 4
+    )
+    tgt, valid = np.asarray(tgt), np.asarray(valid)
+    assert valid.all()  # path graph: every node has a neighbor
+    for i in range(16):
+        nbrs = set(g.neighbors(i).tolist())
+        assert set(tgt[i].tolist()) <= nbrs
+
+
+def test_sample_targets_isolated_nodes_invalid():
+    edges = np.array([[0, 1]])
+    g = build_csr(4, edges)  # nodes 2,3 isolated
+    _, valid = sample_fanout_targets(
+        jax.random.key(1), jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx), 3
+    )
+    valid = np.asarray(valid)
+    assert valid[0].all() and valid[1].all()
+    assert not valid[2].any() and not valid[3].any()
+
+
+def test_push_fanout_delivers_only_to_targets():
+    transmit = jnp.zeros((4, 3), dtype=bool).at[0, 1].set(True)
+    targets = jnp.array([[2], [0], [0], [0]], dtype=jnp.int32)
+    valid = jnp.array([[True], [False], [False], [False]])
+    out = np.asarray(push_fanout(transmit, targets, valid))
+    assert out[2, 1] and out.sum() == 1
+
+
+def test_pull_fanout_gathers():
+    transmit = jnp.zeros((3, 2), dtype=bool).at[1, 0].set(True)
+    targets = jnp.array([[1], [2], [1]], dtype=jnp.int32)
+    valid = jnp.ones((3, 1), dtype=bool)
+    out = np.asarray(pull_fanout(transmit, targets, valid))
+    np.testing.assert_array_equal(out[:, 0], [True, False, True])
+
+
+def test_heartbeat_cadence():
+    n = 4
+    last = jnp.zeros((n,), jnp.int32)
+    alive = jnp.ones((n,), bool)
+    silent = jnp.zeros((n,), bool).at[1].set(True)
+    dead = jnp.zeros((n,), bool)
+    # round 3 is a heartbeat tick (period 3); round 4 is not
+    out3 = np.asarray(emit_heartbeats(last, alive, silent, dead, jnp.int32(3), 3))
+    out4 = np.asarray(emit_heartbeats(last, alive, silent, dead, jnp.int32(4), 3))
+    np.testing.assert_array_equal(out3, [3, 0, 3, 3])  # silent peer skipped
+    np.testing.assert_array_equal(out4, [0, 0, 0, 0])
+
+
+def test_detector_probe_revives_responsive_peer():
+    """A stale-but-responsive peer answers the PING (Peer.py:201-205) and is
+    NOT declared dead — last_hb refreshes instead."""
+    n = 2
+    last = jnp.array([0, 0], jnp.int32)
+    alive = jnp.ones((n,), bool)
+    silent = jnp.array([False, True])
+    dead = jnp.zeros((n,), bool)
+    rnd = jnp.int32(8)  # stale (8 - 0 > 6), sweep round (8 % 2 == 0)
+    new_last, new_dead = detect_failures(last, alive, silent, dead, rnd, 6, 2)
+    np.testing.assert_array_equal(np.asarray(new_last), [8, 0])
+    np.testing.assert_array_equal(np.asarray(new_dead), [False, True])
+
+
+def test_detector_only_sweeps_on_schedule():
+    last = jnp.array([0], jnp.int32)
+    alive = jnp.ones((1,), bool)
+    silent = jnp.ones((1,), bool)
+    dead = jnp.zeros((1,), bool)
+    _, d = detect_failures(last, alive, silent, dead, jnp.int32(9), 6, 2)
+    assert not bool(d[0])  # round 9 is not a sweep round

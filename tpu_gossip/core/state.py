@@ -65,12 +65,17 @@ class SwarmConfig:
     round_seconds: float = 5.0  # gossip tick (Peer.py:396-408)
     forward_once: bool = False  # True: relay a message only on first receipt
     sir_recover_rounds: int = 0  # >0 enables SIR: recover this many rounds after infection
+    mode: str = "push"  # "push" | "push_pull" | "flood" (BASELINE configs 1-4)
+    churn_leave_prob: float = 0.0  # per-round P(alive peer departs) — Poisson churn
+    churn_join_prob: float = 0.0  # per-round P(vacant slot rejoins)
 
     def __post_init__(self):
         if self.n_peers <= 0:
             raise ValueError("n_peers must be positive")
         if self.msg_slots <= 0:
             raise ValueError("msg_slots must be positive")
+        if self.mode not in ("push", "push_pull", "flood"):
+            raise ValueError(f"unknown mode {self.mode!r}")
 
 
 @jax.tree_util.register_dataclass

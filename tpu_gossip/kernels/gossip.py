@@ -1,0 +1,117 @@
+"""Gossip dissemination ops: one round = batched gather/scatter over CSR.
+
+The reference's gossip "round" is each peer thread writing one line to each
+connected socket (reference Peer.py:395-408) with no receive-side handling
+(Peer.py:286,206 just log). The TPU design replaces per-socket sends with
+array ops over the whole swarm at once:
+
+- ``push_fanout``: every transmitting peer scatters its message bitmap to
+  ``k`` uniformly sampled neighbors (classic push gossip; the reference's
+  subset-limited broadcast generalized to epidemic relay).
+- ``pull_fanout``: every peer gathers from ``k`` sampled neighbors (no
+  scatter conflicts — the pull half of push-pull anti-entropy,
+  BASELINE.json config 3).
+- ``flood_all``: push to *all* neighbors via an edge-gather + segment-OR —
+  the deterministic flooding upper bound used for conformance runs.
+
+All take/return plain arrays so the same code runs under `jit`, inside
+`shard_map` partitions (dist/mesh.py), and as a reference implementation for
+the Pallas kernels. Message state is a per-peer boolean bitmap over
+``msg_slots`` hash slots (hash-based dedup per BASELINE.json's north star:
+a peer "has" a message iff its slot bit is set, so re-receipt is a no-op).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_fanout_targets", "push_fanout", "pull_fanout", "flood_all", "edge_sources"]
+
+
+def edge_sources(row_ptr: jax.Array, num_edges: int) -> jax.Array:
+    """Row (source peer) id of every CSR entry: int32 (D,).
+
+    ``num_edges`` must equal ``col_idx.shape[0]`` (static under jit).
+    """
+    n = row_ptr.shape[0] - 1
+    deg = row_ptr[1:] - row_ptr[:-1]
+    return jnp.repeat(
+        jnp.arange(n, dtype=jnp.int32), deg, total_repeat_length=num_edges
+    )
+
+
+def sample_fanout_targets(
+    key: jax.Array, row_ptr: jax.Array, col_idx: jax.Array, fanout: int
+) -> tuple[jax.Array, jax.Array]:
+    """Sample ``fanout`` uniform neighbors per peer (with replacement).
+
+    Returns ``(targets, valid)``: int32 (N, K) neighbor ids and a bool (N, K)
+    mask (False where a peer has no neighbors). Uniform-over-neighbors is the
+    vectorized analogue of the reference pushing to its connected subset
+    (Peer.py:402): on a power-law graph, landing on a hub is automatically
+    degree-proportional.
+    """
+    n = row_ptr.shape[0] - 1
+    deg = row_ptr[1:] - row_ptr[:-1]
+    if col_idx.shape[0] == 0:
+        return (
+            jnp.zeros((n, fanout), dtype=jnp.int32),
+            jnp.zeros((n, fanout), dtype=bool),
+        )
+    u = jax.random.uniform(key, (n, fanout))
+    off = jnp.minimum((u * deg[:, None]).astype(jnp.int32), deg[:, None] - 1)
+    idx = jnp.clip(row_ptr[:-1, None] + off, 0, col_idx.shape[0] - 1)
+    valid = jnp.broadcast_to((deg > 0)[:, None], (n, fanout))
+    return col_idx[idx], valid
+
+
+def push_fanout(
+    transmit: jax.Array, targets: jax.Array, push_valid: jax.Array
+) -> jax.Array:
+    """Scatter-OR each sender's message bitmap into its sampled targets.
+
+    ``transmit``: bool (N, M) — slots each peer pushes this round.
+    ``targets``/``push_valid``: (N, K) from :func:`sample_fanout_targets`,
+    with sender-side masks (dead/silenced senders) folded into ``push_valid``.
+    Returns ``incoming``: bool (N, M) — slots delivered to each peer (dedup
+    happens when the caller ORs into ``seen``).
+    """
+    n, m = transmit.shape
+    k = targets.shape[1]
+    payload = transmit[:, None, :] & push_valid[:, :, None]  # (N, K, M)
+    return (
+        jnp.zeros((n, m), dtype=bool)
+        .at[targets.reshape(-1)]
+        .max(payload.reshape(n * k, m), mode="drop")
+    )
+
+
+def pull_fanout(
+    transmit: jax.Array, targets: jax.Array, valid: jax.Array
+) -> jax.Array:
+    """Gather-OR from each peer's sampled neighbors (anti-entropy pull half).
+
+    Conflict-free by construction: each row only reads. Returns ``incoming``
+    bool (N, M).
+    """
+    got = transmit[targets] & valid[:, :, None]  # (N, K, M)
+    return got.any(axis=1)
+
+
+def flood_all(
+    transmit: jax.Array, row_ptr: jax.Array, col_idx: jax.Array
+) -> jax.Array:
+    """Push to *all* neighbors: edge-gather + segment-OR over the CSR.
+
+    Formulated as a pull over incoming edges (undirected CSR stores both
+    directions): ``incoming[i] = OR_{j in N(i)} transmit[j]`` — a (D, M)
+    gather reduced by source row. Deterministic; used for conformance curves
+    and as the flooding upper bound.
+    """
+    n = row_ptr.shape[0] - 1
+    if col_idx.shape[0] == 0:
+        return jnp.zeros_like(transmit)
+    src = edge_sources(row_ptr, col_idx.shape[0])
+    vals = transmit[col_idx].astype(jnp.uint8)  # (D, M)
+    return jax.ops.segment_max(vals, src, num_segments=n).astype(bool)

@@ -1,0 +1,70 @@
+"""Vectorized liveness protocol: heartbeats, staleness probe, dead declaration.
+
+The reference runs this as wall-clock threads per peer: a 15 s heartbeat
+broadcaster (reference Peer.py:365-393), and a 10 s failure-detector sweep
+that marks a peer stale after 30 s, sends "PING", waits a 2 s grace, then
+declares it dead (Peer.py:298-363). Silent mode (operator types "1",
+Peer.py:437-439) suppresses heartbeats and PING replies without closing
+sockets — the fault the detector is built to catch.
+
+Round-based mapping (1 round = SwarmConfig.round_seconds, default 5 s):
+heartbeat every ``hb_period_rounds`` (3 ≡ 15 s), stale after
+``timeout_rounds`` (6 ≡ 30 s ≈ "3 missed heartbeats", BASELINE.json
+config 2), detector sweep every ``detect_period_rounds`` (2 ≡ 10 s). The
+PING + grace-wait is collapsed into the sweep: a responsive stale peer
+refreshes its heartbeat (exactly the reference's "heartbeat during the
+grace wait revives the node", Peer.py:309,339); an unresponsive one is
+declared dead, the vectorized form of the registry purge (Seed.py:358-406).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["emit_heartbeats", "detect_failures"]
+
+
+def emit_heartbeats(
+    last_hb: jax.Array,
+    alive: jax.Array,
+    silent: jax.Array,
+    declared_dead: jax.Array,
+    rnd: jax.Array,
+    hb_period_rounds: int,
+) -> jax.Array:
+    """Refresh ``last_hb`` for every peer emitting a heartbeat this round.
+
+    Crashed (``~alive``) and silenced peers emit nothing (Peer.py:367);
+    declared-dead peers have had their connections closed (Peer.py:314-320),
+    so their heartbeats no longer reach anyone.
+    """
+    tick = (rnd % hb_period_rounds) == 0
+    emit = alive & ~silent & ~declared_dead & tick
+    return jnp.where(emit, rnd, last_hb)
+
+
+def detect_failures(
+    last_hb: jax.Array,
+    alive: jax.Array,
+    silent: jax.Array,
+    declared_dead: jax.Array,
+    rnd: jax.Array,
+    timeout_rounds: int,
+    detect_period_rounds: int,
+) -> tuple[jax.Array, jax.Array]:
+    """One failure-detector sweep; returns ``(last_hb, declared_dead)``.
+
+    On sweep rounds, stale peers (no heartbeat for > ``timeout_rounds``) are
+    probed: a responsive peer (alive, not silent) answers with a heartbeat
+    (Peer.py:201-205) which refreshes ``last_hb``; an unresponsive one is
+    declared dead — the batched equivalent of "Dead Node" reporting + purge
+    (Peer.py:310-320 → Seed.py:358-406). Idempotent on already-dead peers,
+    mirroring the seeds' early return on re-receipt (Seed.py:373-375).
+    """
+    sweep = (rnd % detect_period_rounds) == 0
+    stale = (rnd - last_hb) > timeout_rounds
+    responsive = alive & ~silent
+    new_last = jnp.where(sweep & stale & responsive, rnd, last_hb)
+    newly_dead = sweep & stale & ~responsive & ~declared_dead
+    return new_last, declared_dead | newly_dead

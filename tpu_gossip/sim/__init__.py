@@ -1,0 +1,17 @@
+"""Round-driven swarm simulation engine and metrics.
+
+Replaces the reference's wall-clock, thread-per-connection runtime
+(reference Peer.py:410-446, Seed.py:457-461) with a jit-compiled round loop
+over the whole swarm: `engine` advances protocol state one round at a time
+(`lax.scan` for fixed horizons, `lax.while_loop` for run-to-coverage),
+`metrics` turns round histories into the BASELINE.json reporting metrics.
+"""
+
+from tpu_gossip.sim.engine import (
+    RoundStats,
+    gossip_round,
+    simulate,
+    run_until_coverage,
+)
+
+__all__ = ["RoundStats", "gossip_round", "simulate", "run_until_coverage"]

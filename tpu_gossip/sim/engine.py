@@ -1,0 +1,216 @@
+"""The jit-compiled protocol round loop — the heart of the tpu-sim transport.
+
+One call to :func:`gossip_round` advances the ENTIRE swarm one round:
+dissemination (push / push-pull / flood over the CSR adjacency), SIR
+recovery, heartbeat emission, failure detection, and Poisson churn — all as
+batched array ops on the :class:`~tpu_gossip.core.state.SwarmState` pytree.
+This is the TPU-native replacement for the reference's per-process thread
+mesh (gossip_sender Peer.py:395-408, periodic_peer_heartbeat Peer.py:365-393,
+monitor_peer_heartbeats Peer.py:298-363), with real epidemic relay +
+hash-slot dedup where the reference only logs received gossip
+(Peer.py:286,206; BASELINE.json north star).
+
+Control flow is compiler-friendly: :func:`simulate` is a ``lax.scan`` over a
+fixed horizon (full per-round metric history), :func:`run_until_coverage` a
+``lax.while_loop`` that stops at a coverage target (the benchmark path —
+no host round-trips until the loop exits). Both jit once per
+(config, shapes) and are sharding-agnostic: under a
+``jax.sharding.Mesh`` the same code runs 1-D sharded on the peer axis
+(dist/mesh.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_gossip.core.state import SwarmConfig, SwarmState
+from tpu_gossip.kernels.gossip import (
+    flood_all,
+    pull_fanout,
+    push_fanout,
+    sample_fanout_targets,
+)
+from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
+
+__all__ = ["RoundStats", "gossip_round", "simulate", "run_until_coverage"]
+
+
+class RoundStats(NamedTuple):
+    """Per-round observability (SURVEY.md §5.5): structured metrics instead of
+    the reference's log-line archaeology (Peer.py:40-49)."""
+
+    coverage: jax.Array  # f32 — fraction of live peers having seen slot 0
+    msgs_sent: jax.Array  # i32 — point-to-point sends this round
+    n_infected: jax.Array  # i32 — peers having seen slot 0 (incl. recovered)
+    n_alive: jax.Array  # i32 — alive & not declared dead
+    n_declared_dead: jax.Array  # i32 — failure-detector verdicts so far
+
+
+def _stats(state: SwarmState, msgs_sent: jax.Array) -> RoundStats:
+    live = state.alive & ~state.declared_dead
+    return RoundStats(
+        coverage=state.coverage(0),  # the one coverage definition (state.py)
+        msgs_sent=msgs_sent.astype(jnp.int32),
+        n_infected=jnp.sum(state.seen[:, 0] & live).astype(jnp.int32),
+        n_alive=jnp.sum(live).astype(jnp.int32),
+        n_declared_dead=jnp.sum(state.declared_dead).astype(jnp.int32),
+    )
+
+
+def gossip_round(
+    state: SwarmState, cfg: SwarmConfig
+) -> tuple[SwarmState, RoundStats]:
+    """Advance the swarm one round. Pure; jit-able with ``cfg`` static."""
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+
+    # --- roles this round -------------------------------------------------
+    # declared-dead peers have had their sockets closed on both sides
+    # (Peer.py:314-320), so they neither send nor receive; silent peers keep
+    # gossiping (silence only gates heartbeats/PING replies, Peer.py:367,202);
+    # SIR-recovered peers stop transmitting but retain their seen set.
+    active = state.alive & ~state.declared_dead
+    transmitter = active & ~state.recovered
+    receptive = active & ~state.recovered
+
+    transmit = state.seen & transmitter[:, None]
+    if cfg.forward_once:
+        transmit = transmit & ~state.forwarded
+
+    # --- dissemination ----------------------------------------------------
+    msgs_sent = jnp.zeros((), dtype=jnp.int32)
+    incoming = jnp.zeros_like(state.seen)
+    if cfg.mode in ("push", "push_pull"):
+        tgt, valid = sample_fanout_targets(
+            k_push, state.row_ptr, state.col_idx, cfg.fanout
+        )
+        push_valid = valid & transmitter[:, None]
+        incoming = incoming | push_fanout(transmit, tgt, push_valid)
+        msgs_sent = msgs_sent + jnp.sum(
+            transmit.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
+        )
+    if cfg.mode == "push_pull":
+        # anti-entropy pull half (BASELINE config 3): each live peer asks one
+        # random neighbor for everything it has — the responder's full seen
+        # set, NOT the forward_once-masked transmit bitmap (relay budgets
+        # limit pushing, never answering a pull).
+        answer = state.seen & transmitter[:, None]
+        ptgt, pvalid = sample_fanout_targets(k_pull, state.row_ptr, state.col_idx, 1)
+        pull_ok = pvalid & receptive[:, None]
+        pull_got = pull_fanout(answer, ptgt, pull_ok)
+        incoming = incoming | pull_got
+        # cost = one request per puller + the responder's shipped bitmap
+        msgs_sent = msgs_sent + jnp.sum(pull_ok.astype(jnp.int32)) + jnp.sum(
+            answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pull_ok[:, 0]
+        )
+    if cfg.mode == "flood":
+        incoming = incoming | flood_all(transmit, state.row_ptr, state.col_idx)
+        deg = state.row_ptr[1:] - state.row_ptr[:-1]
+        msgs_sent = msgs_sent + jnp.sum(transmit.sum(-1, dtype=jnp.int32) * deg)
+
+    incoming = incoming & receptive[:, None]
+    seen = state.seen | incoming
+    forwarded = (state.forwarded | transmit) if cfg.forward_once else state.forwarded
+
+    newly_infected = incoming.any(-1) & ~state.seen.any(-1)
+    infected_round = jnp.where(
+        newly_infected & (state.infected_round < 0), rnd, state.infected_round
+    )
+
+    # --- SIR recovery (BASELINE config 4) ---------------------------------
+    recovered = state.recovered
+    if cfg.sir_recover_rounds > 0:
+        recovered = recovered | (
+            (infected_round >= 0) & (rnd - infected_round >= cfg.sir_recover_rounds)
+        )
+
+    # --- liveness ---------------------------------------------------------
+    last_hb = emit_heartbeats(
+        state.last_hb, state.alive, state.silent, state.declared_dead,
+        rnd, cfg.hb_period_rounds,
+    )
+    last_hb, declared_dead = detect_failures(
+        last_hb, state.alive, state.silent, state.declared_dead,
+        rnd, cfg.timeout_rounds, cfg.detect_period_rounds,
+    )
+
+    # --- Poisson churn (BASELINE config 5) --------------------------------
+    alive = state.alive
+    silent = state.silent
+    if cfg.churn_leave_prob > 0.0:
+        leave = alive & (jax.random.uniform(k_leave, alive.shape) < cfg.churn_leave_prob)
+        alive = alive & ~leave
+    if cfg.churn_join_prob > 0.0:
+        # vacant slots rejoin with fresh protocol state; their edges were
+        # preallocated at graph build (jit-friendly churn, SURVEY.md §7.4:
+        # fixed slots + alive masks instead of per-round CSR rebuilds).
+        join = (~alive) & (
+            jax.random.uniform(k_join, alive.shape) < cfg.churn_join_prob
+        )
+        alive = alive | join
+        fresh = join
+        seen = seen & ~fresh[:, None]
+        forwarded = forwarded & ~fresh[:, None]
+        infected_round = jnp.where(fresh, -1, infected_round)
+        recovered = recovered & ~fresh
+        silent = silent & ~fresh
+        last_hb = jnp.where(fresh, rnd, last_hb)
+        declared_dead = declared_dead & ~fresh
+
+    new_state = SwarmState(
+        row_ptr=state.row_ptr,
+        col_idx=state.col_idx,
+        seen=seen,
+        forwarded=forwarded,
+        infected_round=infected_round,
+        recovered=recovered,
+        alive=alive,
+        silent=silent,
+        last_hb=last_hb,
+        declared_dead=declared_dead,
+        rng=key,
+        round=rnd,
+    )
+    return new_state, _stats(new_state, msgs_sent)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
+def simulate(
+    state: SwarmState, cfg: SwarmConfig, num_rounds: int
+) -> tuple[SwarmState, RoundStats]:
+    """Run a fixed horizon of rounds; returns final state + stacked per-round
+    stats (each field shaped (num_rounds,)) — the coverage-vs-round curve."""
+
+    def body(carry, _):
+        nxt, stats = gossip_round(carry, cfg)
+        return nxt, stats
+
+    return jax.lax.scan(body, state, None, length=num_rounds)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_rounds", "slot"))
+def run_until_coverage(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    target: float = 0.99,
+    max_rounds: int = 1000,
+    slot: int = 0,
+) -> SwarmState:
+    """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
+
+    The benchmark path: a single ``lax.while_loop`` on device, no host
+    round-trips. Rounds used = ``result.round - state.round``.
+    """
+
+    def cond(s: SwarmState) -> jax.Array:
+        return (s.coverage(slot) < target) & (s.round - state.round < max_rounds)
+
+    def body(s: SwarmState) -> SwarmState:
+        nxt, _ = gossip_round(s, cfg)
+        return nxt
+
+    return jax.lax.while_loop(cond, body, state)
