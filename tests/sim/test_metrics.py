@@ -1,0 +1,82 @@
+"""Metrics + run_sim CLI tests (the minimum end-to-end slice, SURVEY.md §7.3)."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+from tpu_gossip.cli.run_sim import main as run_sim_main
+from tpu_gossip.sim import metrics as M
+from tpu_gossip.sim.engine import simulate
+
+
+@pytest.fixture(scope="module")
+def setup():
+    g = build_csr(256, preferential_attachment(256, m=3, use_native=False))
+    cfg = SwarmConfig(n_peers=256, msg_slots=8)
+    return cfg, init_swarm(g, cfg, origins=[0])
+
+
+def test_rounds_to_coverage(setup):
+    cfg, st = setup
+    _, stats = simulate(st, cfg, 25)
+    r = M.rounds_to_coverage(stats, 0.99)
+    cov = np.asarray(stats.coverage)
+    assert r > 0 and cov[r - 1] >= 0.99
+    assert r == 1 or cov[r - 2] < 0.99
+    assert M.rounds_to_coverage(stats, 1.1) == -1  # unreachable target
+
+
+def test_bench_swarm_agrees_with_curve(setup):
+    cfg, st = setup
+    res = M.bench_swarm(st, cfg, 0.99, 200)
+    _, stats = simulate(st, cfg, res.rounds)
+    assert float(np.asarray(stats.coverage)[-1]) >= 0.99
+    assert res.coverage >= 0.99
+    assert res.peers_rounds_per_sec > 0
+    assert json.loads(res.to_json())["n_peers"] == 256
+
+
+def test_jsonl_rows(setup):
+    cfg, st = setup
+    _, stats = simulate(st, cfg, 5)
+    buf = io.StringIO()
+    M.write_jsonl(stats, buf)
+    rows = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert len(rows) == 5
+    assert rows[0]["round"] == 1
+    assert set(rows[0]) == {
+        "round", "coverage", "msgs_sent", "n_infected", "n_alive", "n_declared_dead",
+    }
+
+
+def test_cli_fixed_horizon(capsys):
+    rc = run_sim_main(
+        ["--peers", "128", "--rounds", "10", "--slots", "4", "--quiet"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["summary"] and summary["rounds_run"] == 10
+
+
+def test_cli_run_to_target(capsys):
+    rc = run_sim_main(["--peers", "128", "--slots", "4", "--quiet", "--graph", "chung-lu"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["coverage"] >= summary["target"]
+
+
+def test_cli_checkpoint(tmp_path, capsys):
+    ck = tmp_path / "final.npz"
+    rc = run_sim_main(
+        ["--peers", "128", "--rounds", "5", "--slots", "4", "--quiet",
+         "--checkpoint", str(ck)]
+    )
+    assert rc == 0 and ck.exists()
+    from tpu_gossip.core.state import load_swarm
+
+    st = load_swarm(ck)
+    assert int(st.round) == 5

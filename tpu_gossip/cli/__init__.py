@@ -1,0 +1,7 @@
+"""Operator entry points.
+
+The reference's CLI is two interactive scripts prompting for a port on stdin
+(reference Seed.py:479-492, Peer.py:456-465). Here: `run_sim` drives the
+batched tpu-sim transport; `run_seed`/`run_peer` run socket-compatible
+nodes (compat layer) with proper argparse flags instead of prompts.
+"""

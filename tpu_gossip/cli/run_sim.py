@@ -1,0 +1,115 @@
+"""Run a whole gossip swarm on the TPU: the minimum end-to-end slice.
+
+Example (SURVEY.md §7.3: 1k-peer power-law swarm to 99% coverage):
+
+    python -m tpu_gossip.cli.run_sim --peers 1000 --gamma 2.5 --target 0.99
+
+Prints one JSONL row per round (coverage, msgs, liveness counts) and a final
+summary with rounds-to-target and peers·rounds/sec. This single invocation
+replaces the reference's N-terminal manual procedure (readme.md:1-9: one
+process per node, logs tailed by hand).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--peers", type=int, default=1000, help="swarm size N")
+    p.add_argument(
+        "--graph",
+        choices=["pa", "chung-lu"],
+        default="pa",
+        help="pa: preferential attachment (Barabási–Albert); "
+        "chung-lu: configuration model with P(d)~d^-gamma",
+    )
+    p.add_argument("--gamma", type=float, default=2.5, help="power-law exponent (chung-lu)")
+    p.add_argument("--m", type=int, default=3, help="edges per new node (pa)")
+    p.add_argument("--mode", choices=["push", "push_pull", "flood"], default="push")
+    p.add_argument("--fanout", type=int, default=3)
+    p.add_argument("--slots", type=int, default=16, help="hash-dedup message slots")
+    p.add_argument("--origins", type=int, default=1, help="number of initially infected peers")
+    p.add_argument("--target", type=float, default=0.99, help="coverage target")
+    p.add_argument("--rounds", type=int, default=0, help="fixed horizon (0 = run to target)")
+    p.add_argument("--max-rounds", type=int, default=1000)
+    p.add_argument("--forward-once", action="store_true")
+    p.add_argument("--sir-recover", type=int, default=0, help="rounds until SIR recovery (0 = off)")
+    p.add_argument("--silent-frac", type=float, default=0.0, help="fraction of peers made silent (fault injection)")
+    p.add_argument("--churn-leave", type=float, default=0.0, help="per-round leave probability")
+    p.add_argument("--churn-join", type=float, default=0.0, help="per-round rejoin probability")
+    p.add_argument("--seed", type=int, default=0, help="RNG seed")
+    p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
+    p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+
+    from tpu_gossip.core import topology
+    from tpu_gossip.core.state import SwarmConfig, init_swarm, save_swarm
+    from tpu_gossip.sim import metrics as M
+    from tpu_gossip.sim.engine import simulate
+
+    rng = np.random.default_rng(args.seed)
+    if args.graph == "pa":
+        edges = topology.preferential_attachment(args.peers, m=args.m, rng=rng)
+    else:
+        deg = topology.powerlaw_degree_sequence(args.peers, gamma=args.gamma, rng=rng)
+        edges = topology.configuration_model(deg, rng=rng)
+    graph = topology.build_csr(args.peers, edges)
+
+    cfg = SwarmConfig(
+        n_peers=args.peers,
+        msg_slots=args.slots,
+        fanout=args.fanout,
+        mode=args.mode,
+        forward_once=args.forward_once,
+        sir_recover_rounds=args.sir_recover,
+        churn_leave_prob=args.churn_leave,
+        churn_join_prob=args.churn_join,
+    )
+    origins = rng.choice(args.peers, size=min(args.origins, args.peers), replace=False)
+    state = init_swarm(graph, cfg, key=jax.random.key(args.seed), origins=origins)
+    if args.silent_frac > 0:
+        k = int(args.silent_frac * args.peers)
+        silent_ids = rng.choice(args.peers, size=k, replace=False)
+        state.silent = state.silent.at[silent_ids].set(True)
+
+    if args.rounds > 0:
+        fin, stats = simulate(state, cfg, args.rounds)
+        if not args.quiet:
+            M.write_jsonl(stats, sys.stdout)
+        rounds = M.rounds_to_coverage(stats, args.target)
+        summary = {
+            "summary": True,
+            "n_peers": args.peers,
+            "mode": args.mode,
+            "rounds_run": args.rounds,
+            "rounds_to_target": rounds,
+            "final_coverage": float(np.asarray(stats.coverage)[-1]),
+            "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
+        }
+    else:
+        result = M.bench_swarm(state, cfg, args.target, args.max_rounds)
+        fin = None
+        summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
+    print(json.dumps(summary))
+
+    if args.checkpoint:
+        if fin is None:
+            fin, _ = simulate(state, cfg, 1)
+        save_swarm(args.checkpoint, fin)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Structured per-round metrics and benchmark reporting.
+
+The reference's only observability is timestamped log lines in per-node
+files (reference Peer.py:40-49, Seed.py:78-87) plus a 30 s topology dump
+(Seed.py:485-487). Here every round yields a :class:`RoundStats` row;
+this module turns those histories into the BASELINE.json reporting
+metrics — rounds-to-target-coverage and peers·rounds/sec — and emits them
+as JSONL for downstream tooling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import IO, Iterable
+
+import jax
+import numpy as np
+
+from tpu_gossip.core.state import SwarmConfig, SwarmState
+from tpu_gossip.sim.engine import RoundStats, run_until_coverage, simulate
+
+__all__ = [
+    "BenchResult",
+    "rounds_to_coverage",
+    "coverage_curve",
+    "bench_swarm",
+    "write_jsonl",
+    "stats_rows",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchResult:
+    """One benchmark measurement (the BASELINE.json primary metric)."""
+
+    n_peers: int
+    rounds: int  # rounds to reach `target` coverage
+    target: float
+    wall_seconds: float
+    peers_rounds_per_sec: float
+    coverage: float  # coverage actually reached
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def rounds_to_coverage(stats: RoundStats, target: float = 0.99) -> int:
+    """First round index (1-based) at which coverage >= target; -1 if never."""
+    cov = np.asarray(stats.coverage)
+    hit = np.nonzero(cov >= target)[0]
+    return int(hit[0]) + 1 if hit.size else -1
+
+
+def coverage_curve(stats: RoundStats) -> np.ndarray:
+    """Coverage-vs-round curve as a host array (conformance comparisons)."""
+    return np.asarray(stats.coverage)
+
+
+def bench_swarm(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    target: float = 0.99,
+    max_rounds: int = 1000,
+    *,
+    warmup: bool = True,
+) -> BenchResult:
+    """Time the run-to-coverage while_loop on device (compile excluded)."""
+    if warmup:
+        jax.block_until_ready(run_until_coverage(state, cfg, target, max_rounds).seen)
+    t0 = time.perf_counter()
+    fin = run_until_coverage(state, cfg, target, max_rounds)
+    jax.block_until_ready(fin.seen)
+    dt = time.perf_counter() - t0
+    rounds = int(fin.round - state.round)
+    return BenchResult(
+        n_peers=cfg.n_peers,
+        rounds=rounds,
+        target=target,
+        wall_seconds=dt,
+        peers_rounds_per_sec=cfg.n_peers * rounds / max(dt, 1e-9),
+        coverage=float(fin.coverage(0)),
+    )
+
+
+def stats_rows(stats: RoundStats) -> Iterable[dict]:
+    """RoundStats (stacked over rounds) → per-round dict rows."""
+    fields = stats._asdict()
+    arrays = {k: np.asarray(v) for k, v in fields.items()}
+    n = len(arrays["coverage"])
+    for r in range(n):
+        row = {"round": r + 1}
+        for k, v in arrays.items():
+            row[k] = v[r].item()
+        yield row
+
+
+def write_jsonl(stats: RoundStats, sink: IO[str]) -> None:
+    """Emit one JSON object per round (SURVEY.md §5.5)."""
+    for row in stats_rows(stats):
+        sink.write(json.dumps(row) + "\n")
+
+
+def run_with_metrics(
+    state: SwarmState, cfg: SwarmConfig, num_rounds: int, sink: IO[str] | None = None
+) -> tuple[SwarmState, RoundStats]:
+    """simulate() + optional JSONL emission."""
+    fin, stats = simulate(state, cfg, num_rounds)
+    if sink is not None:
+        write_jsonl(stats, sink)
+    return fin, stats
