@@ -1,9 +1,19 @@
 """Test harness: force CPU with 8 virtual devices so multi-chip sharding
-tests run anywhere (SURVEY.md §4) — must run before jax is imported."""
+tests run anywhere (SURVEY.md §4).
+
+The axon (TPU tunnel) sitecustomize imports jax at interpreter start and
+calls jax.config.update("jax_platforms", "axon,cpu"), so env vars alone are
+too late — the config must be re-updated here. XLA_FLAGS still works because
+CPU client creation is lazy (first jax.devices() happens inside the tests).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # belt-and-braces for subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

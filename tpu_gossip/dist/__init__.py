@@ -1,0 +1,30 @@
+"""Multi-chip execution: peers 1-D sharded over a ``jax.sharding.Mesh``.
+
+The reference's "distributed backend" is raw TCP with thread-per-connection
+(SURVEY.md §5.8). Here, cross-node communication is XLA collectives over
+ICI/DCN: the peer axis is sharded across devices, cross-partition edges are
+pre-bucketed by (source shard → destination shard), and a gossip round's
+fan-out is one ``all_to_all`` inside ``shard_map``.
+"""
+
+from tpu_gossip.dist.mesh import (
+    ShardedGraph,
+    make_mesh,
+    partition_graph,
+    shard_swarm,
+    gossip_round_dist,
+    simulate_dist,
+    run_until_coverage_dist,
+    init_sharded_swarm,
+)
+
+__all__ = [
+    "ShardedGraph",
+    "make_mesh",
+    "partition_graph",
+    "shard_swarm",
+    "init_sharded_swarm",
+    "gossip_round_dist",
+    "simulate_dist",
+    "run_until_coverage_dist",
+]

@@ -1,0 +1,343 @@
+"""1-D peer sharding over a device mesh with bucketed all_to_all fan-out.
+
+Design (SURVEY.md §5.8, §7.4). The hard problem is ragged cross-partition
+fan-out: power-law hubs make per-shard edge counts wildly unbalanced, and
+``all_to_all`` needs rectangular payloads. Solution, built once on the host:
+
+1. **Load-balance permutation**: peers are randomly relabeled so hub
+   neighborhoods spread across shards instead of clustering in shard 0
+   (preferential-attachment graphs put hubs at low ids).
+2. **Edge bucketing**: every directed edge (u → v) is filed under the pair
+   (shard(u), shard(v)); buckets are padded to the max bucket size B so the
+   per-shard exchange tensor is a rectangular (S, B, M) block.
+3. **Round exchange**: inside ``shard_map``, each shard gathers its local
+   transmit bits along its out-edges, applies per-edge activation (Bernoulli
+   k/deg for push — the static-shape equivalent of sampling k neighbors —
+   1/deg(dst) for pull, all-on for flood), and one ``lax.all_to_all`` over
+   the mesh routes every bucket to its destination shard, which scatter-ORs
+   into its local ``incoming``. ICI carries the buckets; no host round-trips.
+
+Everything after dissemination (dedup merge, SIR, liveness, churn) reuses
+``sim.engine.advance_round`` — elementwise over the peer axis, so XLA keeps
+it fully sharded with zero extra communication.
+
+The reference's counterpart is one OS process per peer and per-socket
+blocking sends (reference Peer.py:395-408, Seed.py:343-350); its NCCL/MPI
+equivalent does not exist (SURVEY.md §2: no collectives anywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm
+from tpu_gossip.core.topology import Graph, build_csr
+from tpu_gossip.sim.engine import (
+    RoundStats,
+    advance_round,
+    compute_roles,
+    transmit_bitmap,
+)
+
+__all__ = [
+    "ShardedGraph",
+    "make_mesh",
+    "partition_graph",
+    "shard_swarm",
+    "init_sharded_swarm",
+    "gossip_round_dist",
+    "simulate_dist",
+    "run_until_coverage_dist",
+]
+
+AXIS = "peers"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = AXIS) -> Mesh:
+    """1-D mesh over (the first ``n_devices``) available devices."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} available")
+    return Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedGraph:
+    """Pre-bucketed edge routing tables (device arrays) + host metadata.
+
+    Bucket arrays are (S, S, B): ``send_src[s, d, b]`` is the sender-local
+    row of the b-th edge from shard ``s`` to shard ``d`` (pad: 0 with
+    ``send_valid`` False); ``recv_dst[d, s, b]`` the receiver-local row of
+    the same edge, indexed the way the receiving shard reads its
+    ``all_to_all`` result. ``send_dst_deg`` carries the destination's degree
+    to the sender for pull activation.
+    """
+
+    send_src: jax.Array  # int32 (S, S, B)
+    recv_dst: jax.Array  # int32 (S, S, B)
+    send_valid: jax.Array  # bool (S, S, B)
+    send_dst_deg: jax.Array  # int32 (S, S, B)
+    deg: jax.Array  # int32 (n_pad,) — slot degree (0 for pads)
+    n: int = dataclasses.field(metadata=dict(static=True))
+    n_pad: int = dataclasses.field(metadata=dict(static=True))
+    n_shards: int = dataclasses.field(metadata=dict(static=True))
+    per_shard: int = dataclasses.field(metadata=dict(static=True))
+    bucket: int = dataclasses.field(metadata=dict(static=True))
+
+
+def partition_graph(
+    graph: Graph,
+    n_shards: int,
+    *,
+    seed: int = 0,
+    permute: bool = True,
+) -> tuple[ShardedGraph, Graph, np.ndarray]:
+    """Partition a host graph for ``n_shards`` devices.
+
+    Returns ``(sharded_graph, relabeled_graph, position)`` where
+    ``relabeled_graph`` is the padded, permuted CSR (so the single-device
+    engine can run the *identical* topology for parity tests) and
+    ``position[old_id] = slot`` maps original peer ids to state rows.
+    """
+    n, s = graph.n, n_shards
+    per = math.ceil(n / s)
+    n_pad = per * s
+    rng = np.random.default_rng(seed)
+    position = rng.permutation(n) if permute else np.arange(n)
+
+    src = position[np.repeat(np.arange(n), graph.degrees)].astype(np.int64)
+    dst = position[graph.col_idx.astype(np.int64)]
+
+    und = src < dst  # each undirected edge once, in relabeled ids
+    relabeled = build_csr(n_pad, np.stack([src[und], dst[und]], axis=1))
+
+    deg = (relabeled.row_ptr[1:] - relabeled.row_ptr[:-1]).astype(np.int32)
+
+    gid = (src // per) * s + (dst // per)  # (S*S,) bucket id per directed edge
+    counts = np.bincount(gid, minlength=s * s)
+    b = max(int(counts.max()), 1)
+    order = np.argsort(gid, kind="stable")
+    gs, ss, ds = gid[order], src[order], dst[order]
+    starts = np.zeros(s * s + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    k = np.arange(len(gs)) - starts[gs]
+
+    send_src = np.zeros((s * s, b), dtype=np.int32)
+    recv_dst = np.zeros((s * s, b), dtype=np.int32)
+    send_valid = np.zeros((s * s, b), dtype=bool)
+    send_dst_deg = np.ones((s * s, b), dtype=np.int32)
+    send_src[gs, k] = (ss - (gs // s) * per).astype(np.int32)
+    recv_dst[gs, k] = (ds - (gs % s) * per).astype(np.int32)
+    send_valid[gs, k] = True
+    send_dst_deg[gs, k] = deg[ds]
+
+    sg = ShardedGraph(
+        send_src=jnp.asarray(send_src.reshape(s, s, b)),
+        # receiver d reads its all_to_all result indexed by sender shard s,
+        # so transpose the (s, d) bucket grid to (d, s)
+        recv_dst=jnp.asarray(recv_dst.reshape(s, s, b).transpose(1, 0, 2)),
+        send_valid=jnp.asarray(send_valid.reshape(s, s, b)),
+        send_dst_deg=jnp.asarray(send_dst_deg.reshape(s, s, b)),
+        deg=jnp.asarray(deg),
+        n=n,
+        n_pad=n_pad,
+        n_shards=s,
+        per_shard=per,
+        bucket=b,
+    )
+    return sg, relabeled, position
+
+
+def init_sharded_swarm(
+    sg: ShardedGraph,
+    relabeled: Graph,
+    position: np.ndarray,
+    cfg: SwarmConfig,
+    *,
+    key: jax.Array | None = None,
+    origins: np.ndarray | list[int] | None = None,
+    origin_slot: int = 0,
+) -> SwarmState:
+    """SwarmState over the padded slot space; pad slots are born dead.
+
+    ``cfg.n_peers`` must equal ``sg.n_pad``; ``origins`` are ORIGINAL peer
+    ids (mapped through ``position``). Pad slots get ``alive=False`` and
+    ``declared_dead=True`` so every protocol path ignores them (the detector
+    is idempotent on already-dead peers).
+    """
+    if cfg.n_peers != sg.n_pad:
+        raise ValueError(f"cfg.n_peers={cfg.n_peers} != n_pad={sg.n_pad}")
+    mapped = None if origins is None else position[np.asarray(origins)]
+    state = init_swarm(relabeled, cfg, key=key, origins=mapped, origin_slot=origin_slot)
+    if sg.n_pad > sg.n:
+        pad = np.zeros(sg.n_pad, dtype=bool)
+        pad[sg.n :] = True
+        pad = jnp.asarray(pad)
+        state.alive = state.alive & ~pad
+        state.declared_dead = state.declared_dead | pad
+    return state
+
+
+def shard_swarm(state: SwarmState, mesh: Mesh) -> SwarmState:
+    """Place per-peer arrays with a peer-axis NamedSharding (topology arrays
+    and scalars replicated)."""
+    peer = NamedSharding(mesh, P(AXIS))
+    repl = NamedSharding(mesh, P())
+    n_pad = state.alive.shape[0]
+
+    def place(x):
+        is_peer_dim = hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n_pad
+        return jax.device_put(x, peer if is_peer_dim else repl)
+
+    return jax.tree.map(place, state)
+
+
+def _exchange(
+    transmit: jax.Array,
+    sg: ShardedGraph,
+    keys: jax.Array,
+    mesh: Mesh,
+    activation: str,  # "push" | "pull" | "flood"
+    fanout: int,
+) -> tuple[jax.Array, jax.Array]:
+    """One bucketed all_to_all fan-out; returns (incoming, msgs_per_shard).
+
+    ``transmit`` (n_pad, M) is peer-sharded; ``keys`` is an (S,) key array
+    (one per shard). ``msgs_per_shard`` is (S,) slot-sends per shard.
+    """
+    s, b = sg.n_shards, sg.bucket
+    per = sg.per_shard
+    m = transmit.shape[1]
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS)),
+    )
+    def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, deg_blk, key_blk):
+        send_src, recv_dst = send_src[0], recv_dst[0]  # (S, B)
+        valid, dst_deg = valid[0], dst_deg[0]
+        vals = transmit_blk[send_src]  # (S, B, M)
+        if activation == "flood":
+            active = valid
+        elif activation == "push":
+            # Bernoulli k/deg(src) per out-edge ≡ fanout-k sampling with
+            # static shapes (expected k pushes per transmitting peer)
+            p = fanout / jnp.maximum(deg_blk[send_src], 1)
+            active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
+        else:  # pull: destination draws ~1 incoming edge
+            p = 1.0 / jnp.maximum(dst_deg, 1)
+            active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
+        payload = vals & active[:, :, None]  # (S, B, M)
+        msgs = jnp.sum(payload, dtype=jnp.int32)
+        received = jax.lax.all_to_all(
+            payload, AXIS, split_axis=0, concat_axis=0, tiled=True
+        )  # received[s'] = bucket shard s' packed for me
+        incoming = (
+            jnp.zeros((per, m), dtype=bool)
+            .at[recv_dst.reshape(-1)]
+            .max(received.reshape(s * b, m), mode="drop")
+        )
+        return incoming, msgs[None]
+
+    return ex(
+        transmit, sg.send_src, sg.recv_dst, sg.send_valid, sg.send_dst_deg,
+        sg.deg, keys,
+    )
+
+
+def gossip_round_dist(
+    state: SwarmState, cfg: SwarmConfig, sg: ShardedGraph, mesh: Mesh
+) -> tuple[SwarmState, RoundStats]:
+    """One multi-chip round: bucketed exchange + the shared protocol tail."""
+    if sg.n_shards != mesh.size:
+        raise ValueError(
+            f"graph partitioned for {sg.n_shards} shards but mesh has "
+            f"{mesh.size} devices — repartition with partition_graph(g, {mesh.size})"
+        )
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    _, transmitter, receptive = compute_roles(state)
+    transmit = transmit_bitmap(state, cfg, transmitter)
+
+    incoming = jnp.zeros_like(state.seen)
+    msgs_sent = jnp.zeros((), dtype=jnp.int32)
+    if cfg.mode in ("push", "push_pull"):
+        inc, msgs = _exchange(
+            transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
+            "push", cfg.fanout,
+        )
+        incoming = incoming | inc
+        msgs_sent = msgs_sent + jnp.sum(msgs)
+    if cfg.mode == "push_pull":
+        answer = state.seen & transmitter[:, None]
+        inc, msgs = _exchange(
+            answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
+            "pull", cfg.fanout,
+        )
+        incoming = incoming | inc
+        # delivered bits + one request per pulling peer, mirroring the local
+        # engine's accounting (sim/engine.py _disseminate_local) so the two
+        # paths report comparable msgs_sent
+        requests = jnp.sum((sg.deg > 0) & receptive, dtype=jnp.int32)
+        msgs_sent = msgs_sent + jnp.sum(msgs) + requests
+    if cfg.mode == "flood":
+        inc, msgs = _exchange(
+            transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
+            "flood", cfg.fanout,
+        )
+        incoming = incoming | inc
+        msgs_sent = msgs_sent + jnp.sum(msgs)
+
+    return advance_round(
+        state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join, receptive
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "num_rounds"))
+def simulate_dist(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    sg: ShardedGraph,
+    mesh: Mesh,
+    num_rounds: int,
+) -> tuple[SwarmState, RoundStats]:
+    """Fixed-horizon multi-chip run (lax.scan), per-round stats history."""
+
+    def body(carry, _):
+        nxt, stats = gossip_round_dist(carry, cfg, sg, mesh)
+        return nxt, stats
+
+    return jax.lax.scan(body, state, None, length=num_rounds)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_rounds", "slot"))
+def run_until_coverage_dist(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    sg: ShardedGraph,
+    mesh: Mesh,
+    target: float = 0.99,
+    max_rounds: int = 1000,
+    slot: int = 0,
+) -> SwarmState:
+    """Multi-chip run-to-coverage (lax.while_loop, no host round-trips)."""
+
+    def cond(st: SwarmState) -> jax.Array:
+        return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)
+
+    def body(st: SwarmState) -> SwarmState:
+        nxt, _ = gossip_round_dist(st, cfg, sg, mesh)
+        return nxt
+
+    return jax.lax.while_loop(cond, body, state)

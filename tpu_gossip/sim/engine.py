@@ -36,7 +36,15 @@ from tpu_gossip.kernels.gossip import (
 )
 from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
 
-__all__ = ["RoundStats", "gossip_round", "simulate", "run_until_coverage"]
+__all__ = [
+    "RoundStats",
+    "compute_roles",
+    "transmit_bitmap",
+    "advance_round",
+    "gossip_round",
+    "simulate",
+    "run_until_coverage",
+]
 
 
 class RoundStats(NamedTuple):
@@ -61,27 +69,42 @@ def _stats(state: SwarmState, msgs_sent: jax.Array) -> RoundStats:
     )
 
 
-def gossip_round(
-    state: SwarmState, cfg: SwarmConfig
-) -> tuple[SwarmState, RoundStats]:
-    """Advance the swarm one round. Pure; jit-able with ``cfg`` static."""
-    rnd = state.round + 1
-    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+def compute_roles(
+    state: SwarmState,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(active, transmitter, receptive) masks for this round.
 
-    # --- roles this round -------------------------------------------------
-    # declared-dead peers have had their sockets closed on both sides
-    # (Peer.py:314-320), so they neither send nor receive; silent peers keep
-    # gossiping (silence only gates heartbeats/PING replies, Peer.py:367,202);
-    # SIR-recovered peers stop transmitting but retain their seen set.
+    Declared-dead peers have had their sockets closed on both sides
+    (Peer.py:314-320), so they neither send nor receive; silent peers keep
+    gossiping (silence only gates heartbeats/PING replies, Peer.py:367,202);
+    SIR-recovered peers stop transmitting but retain their seen set.
+    """
     active = state.alive & ~state.declared_dead
     transmitter = active & ~state.recovered
-    receptive = active & ~state.recovered
+    receptive = active & ~state.recovered  # susceptible: SIR-removed can't reinfect
+    return active, transmitter, receptive
 
+
+def transmit_bitmap(
+    state: SwarmState, cfg: SwarmConfig, transmitter: jax.Array
+) -> jax.Array:
+    """Slots each peer offers to push this round (forward_once budgets apply)."""
     transmit = state.seen & transmitter[:, None]
     if cfg.forward_once:
         transmit = transmit & ~state.forwarded
+    return transmit
 
-    # --- dissemination ----------------------------------------------------
+
+def _disseminate_local(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    transmit: jax.Array,
+    transmitter: jax.Array,
+    receptive: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Single-shard dissemination; returns (incoming, msgs_sent)."""
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     incoming = jnp.zeros_like(state.seen)
     if cfg.mode in ("push", "push_pull"):
@@ -111,7 +134,26 @@ def gossip_round(
         incoming = incoming | flood_all(transmit, state.row_ptr, state.col_idx)
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
         msgs_sent = msgs_sent + jnp.sum(transmit.sum(-1, dtype=jnp.int32) * deg)
+    return incoming, msgs_sent
 
+
+def advance_round(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    incoming: jax.Array,
+    msgs_sent: jax.Array,
+    transmit: jax.Array,
+    rnd: jax.Array,
+    key: jax.Array,
+    k_leave: jax.Array,
+    k_join: jax.Array,
+    receptive: jax.Array,
+) -> tuple[SwarmState, RoundStats]:
+    """Everything after dissemination: dedup-merge, SIR, liveness, churn.
+
+    Shared by the local round (:func:`gossip_round`) and the multi-chip
+    round (dist/mesh.py) so the protocol state machine exists exactly once.
+    """
     incoming = incoming & receptive[:, None]
     seen = state.seen | incoming
     forwarded = (state.forwarded | transmit) if cfg.forward_once else state.forwarded
@@ -176,6 +218,22 @@ def gossip_round(
         round=rnd,
     )
     return new_state, _stats(new_state, msgs_sent)
+
+
+def gossip_round(
+    state: SwarmState, cfg: SwarmConfig
+) -> tuple[SwarmState, RoundStats]:
+    """Advance the swarm one round. Pure; jit-able with ``cfg`` static."""
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    _, transmitter, receptive = compute_roles(state)
+    transmit = transmit_bitmap(state, cfg, transmitter)
+    incoming, msgs_sent = _disseminate_local(
+        state, cfg, transmit, transmitter, receptive, k_push, k_pull
+    )
+    return advance_round(
+        state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join, receptive
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
