@@ -1,0 +1,58 @@
+"""Headline benchmark: 1M-node power-law push gossip to 99% coverage.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "peers_rounds_per_sec", "vs_baseline": N}
+
+Metric per BASELINE.json: rounds-to-99%-coverage and peers·rounds/sec on a
+1M-node power-law (γ=2.5) swarm, run as a single on-device while_loop
+(compile + warmup excluded from timing).
+
+``vs_baseline`` compares against the reference's intrinsic socket-mode
+throughput: one gossip tick per 5 s per peer (reference Peer.py:396-408,
+SURVEY.md §6) at its 1k-peer demonstrated scale ⇒ 1000 peers × 0.2
+rounds/sec = 200 peers·rounds/sec. The reference publishes no other numbers
+(readme.md:1-11; BASELINE.json "published": {}).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+REFERENCE_PEERS_ROUNDS_PER_SEC = 200.0  # 1k peers, 1 round / 5 s (Peer.py:396-408)
+
+
+def main() -> int:
+    import jax
+
+    from tpu_gossip import SwarmConfig, build_csr, init_swarm
+    from tpu_gossip.core.topology import configuration_model, powerlaw_degree_sequence
+    from tpu_gossip.sim.metrics import bench_swarm
+
+    n = 1_000_000
+    rng = np.random.default_rng(0)
+    deg = powerlaw_degree_sequence(n, gamma=2.5, rng=rng)
+    graph = build_csr(n, configuration_model(deg, rng=rng))
+
+    cfg = SwarmConfig(n_peers=n, msg_slots=16, fanout=3)
+    state = init_swarm(graph, cfg, key=jax.random.key(0), origins=[0])
+
+    res = bench_swarm(state, cfg, target=0.99, max_rounds=500)
+    out = {
+        "metric": "1M-node power-law (gamma=2.5) push gossip to 99% coverage",
+        "value": round(res.peers_rounds_per_sec, 1),
+        "unit": "peers_rounds_per_sec",
+        "vs_baseline": round(res.peers_rounds_per_sec / REFERENCE_PEERS_ROUNDS_PER_SEC, 1),
+        "rounds_to_99pct": res.rounds,
+        "wall_seconds": round(res.wall_seconds, 4),
+        "coverage": round(res.coverage, 4),
+        "n_peers": n,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,19 +68,22 @@ def bench_swarm(
 ) -> BenchResult:
     """Time the run-to-coverage while_loop on device (compile excluded)."""
     if warmup:
-        jax.block_until_ready(run_until_coverage(state, cfg, target, max_rounds).seen)
+        float(run_until_coverage(state, cfg, target, max_rounds).coverage(0))
     t0 = time.perf_counter()
     fin = run_until_coverage(state, cfg, target, max_rounds)
-    jax.block_until_ready(fin.seen)
-    dt = time.perf_counter() - t0
+    # host-fetch a scalar inside the timed region: on some platforms (axon
+    # tunnel) block_until_ready returns before execution completes, so the
+    # fetch is the only reliable completion barrier
+    coverage = float(fin.coverage(0))
     rounds = int(fin.round - state.round)
+    dt = time.perf_counter() - t0
     return BenchResult(
         n_peers=cfg.n_peers,
         rounds=rounds,
         target=target,
         wall_seconds=dt,
         peers_rounds_per_sec=cfg.n_peers * rounds / max(dt, 1e-9),
-        coverage=float(fin.coverage(0)),
+        coverage=coverage,
     )
 
 
