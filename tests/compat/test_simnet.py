@@ -1,0 +1,61 @@
+"""tpu-sim transport: same PeerNode constructor, batched device engine
+underneath (the BASELINE.json north-star flag)."""
+
+import pytest
+
+from tpu_gossip.compat.peer import PeerNode
+from tpu_gossip.compat.simnet import SimCluster
+
+
+def make_cluster(n=64, **kw):
+    cluster = SimCluster(msg_slots=16, fanout=3, seed=0, **kw)
+    peers = [
+        PeerNode("10.0.0.1", 9000 + i, transport="tpu-sim", cluster=cluster)
+        for i in range(n)
+    ]
+    cluster.materialize(m=3)
+    return cluster, peers
+
+
+def test_requires_cluster():
+    with pytest.raises(ValueError):
+        PeerNode("127.0.0.1", 1, transport="tpu-sim")
+
+
+def test_gossip_reaches_everyone():
+    cluster, peers = make_cluster(64)
+    peers[0].gossip("hello")
+    assert peers[0].has_seen("hello")
+    cluster.step(25)
+    assert cluster.coverage("hello") >= 0.99
+    assert all(p.has_seen("hello") for p in peers)
+
+
+def test_multiple_messages_dedup_slots():
+    cluster, peers = make_cluster(64)
+    peers[0].gossip("msg-a")
+    peers[10].gossip("msg-b")
+    cluster.step(30)
+    assert cluster.coverage("msg-a") >= 0.99
+    assert cluster.coverage("msg-b") >= 0.99
+
+
+def test_silent_peer_declared_dead():
+    cluster, peers = make_cluster(64)
+    peers[5].set_silent(True)
+    cluster.step(12)  # timeout 6 rounds + sweep 2 → declared by round 8
+    assert cluster.is_declared_dead(peers[5].addr)
+    assert not cluster.is_declared_dead(peers[6].addr)
+
+
+def test_neighbors_power_law():
+    cluster, peers = make_cluster(128)
+    degs = sorted(len(p.neighbors) for p in peers)
+    assert degs[0] >= 3  # PA guarantees m edges per node
+    assert degs[-1] > 3 * degs[len(degs) // 2]  # hubs exist
+
+
+def test_register_after_materialize_rejected():
+    cluster, peers = make_cluster(16)
+    with pytest.raises(RuntimeError):
+        cluster.register_peer(("10.9.9.9", 1))

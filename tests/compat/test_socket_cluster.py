@@ -1,0 +1,154 @@
+"""Integration: a real localhost swarm over the socket transport, timers
+scaled 50× so the whole reference protocol plays out in seconds
+(SURVEY.md §4: the reference's only 'test' was this, manually, in N
+terminals)."""
+
+import asyncio
+import functools
+import socket
+
+
+def asyncio_test(fn):
+    """pytest-asyncio is not in the image; run coroutine tests directly."""
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        return asyncio.run(fn(*a, **kw))
+
+    return wrapper
+
+from tpu_gossip.compat.peer import PeerNode
+from tpu_gossip.compat.seed import SeedNode
+from tpu_gossip.compat.timing import ProtocolTiming
+
+SCALE = 0.02  # 50x faster than the reference contract
+TIMING = ProtocolTiming().scaled(SCALE)
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def start_cluster(tmp_path, n_seeds=2, n_peers=5, **peer_kw):
+    config = tmp_path / "config.txt"
+    config.write_text("")
+    ports = free_ports(n_seeds + n_peers)
+    seeds = []
+    for p in ports[:n_seeds]:
+        s = SeedNode("127.0.0.1", p, str(config), timing=TIMING,
+                     log_dir=str(tmp_path), rng_seed=0)
+        await s.start()
+        seeds.append(s)
+    await asyncio.sleep(TIMING.seed_reconnect_period * 1.5)  # mesh forms
+    peers = []
+    for p in ports[n_seeds:]:
+        node = PeerNode("127.0.0.1", p, str(config), timing=TIMING,
+                        log_dir=str(tmp_path), **peer_kw)
+        await node.start()
+        peers.append(node)
+        await asyncio.sleep(TIMING.registration_settle * 2.5)
+    return seeds, peers
+
+
+async def stop_all(seeds, peers):
+    for n in peers + seeds:
+        await n.stop()
+
+
+@asyncio_test
+async def test_bootstrap_and_seed_mesh(tmp_path):
+    seeds, peers = await start_cluster(tmp_path, n_seeds=3, n_peers=4)
+    try:
+        # config.txt self-registration: every seed appended itself
+        lines = (tmp_path / "config.txt").read_text().splitlines()
+        assert len(lines) == 3
+        # seed mesh is fully connected
+        for s in seeds:
+            assert len(s.seed_writers) == 2
+        # every peer got registered at its quorum of seeds and learned
+        # neighbors (except the very first peer, who had nobody to meet)
+        connected = [p for p in peers if p.neighbors]
+        assert len(connected) >= len(peers) - 1
+        # replicated topology: all seeds eventually know all peers
+        await asyncio.sleep(TIMING.heartbeat_period)
+        peer_addrs = {p.addr for p in peers}
+        for s in seeds:
+            assert peer_addrs <= set(s.known_peers)
+    finally:
+        await stop_all(seeds, peers)
+
+
+@asyncio_test
+async def test_gossip_epidemic_relay(tmp_path):
+    """A message injected at one peer floods the whole swarm through relay +
+    dedup (the north-star generalization; reference gossip is one-hop)."""
+    seeds, peers = await start_cluster(tmp_path, n_seeds=2, n_peers=6)
+    try:
+        peers[0].gossip("hello-swarm")
+        await asyncio.sleep(TIMING.gossip_period * 6)
+        got = [p for p in peers if p.has_seen("hello-swarm")]
+        assert len(got) == len(peers)
+        # dedup: each peer recorded it exactly once
+        for p in peers:
+            assert p.gossip_log.count("hello-swarm") == 1
+    finally:
+        await stop_all(seeds, peers)
+
+
+@asyncio_test
+async def test_silent_peer_detected_and_purged(tmp_path):
+    """Silent-mode fault: neighbors PING, declare dead, report to seeds,
+    seeds purge the node from the replicated topology (Peer.py:298-363 →
+    Seed.py:358-406)."""
+    seeds, peers = await start_cluster(tmp_path, n_seeds=2, n_peers=5)
+    try:
+        victim = next(p for p in peers if p.neighbors)
+        victim.set_silent(True)
+        # worst case ≈ timeout + sweep + grace (SURVEY §6: 30-42 s real time)
+        await asyncio.sleep(
+            TIMING.heartbeat_timeout + 3 * TIMING.detect_period + 3 * TIMING.ping_grace
+        )
+        assert all(victim.addr not in s.network_topology for s in seeds)
+        assert all(victim.addr not in s.known_peers for s in seeds)
+    finally:
+        await stop_all(seeds, peers)
+
+
+@asyncio_test
+async def test_healthy_swarm_no_false_positives(tmp_path):
+    seeds, peers = await start_cluster(tmp_path, n_seeds=2, n_peers=4)
+    try:
+        await asyncio.sleep(TIMING.heartbeat_timeout * 1.5)
+        peer_addrs = {p.addr for p in peers}
+        for s in seeds:
+            assert peer_addrs <= set(s.known_peers)  # nobody purged
+    finally:
+        await stop_all(seeds, peers)
+
+
+@asyncio_test
+async def test_reference_conformant_one_hop(tmp_path):
+    """gossip_relay=False reproduces the reference's log-only receive
+    (Peer.py:286,206): messages reach direct neighbors only."""
+    seeds, peers = await start_cluster(tmp_path, n_seeds=2, n_peers=6,
+                                       gossip_relay=False)
+    try:
+        origin = max(peers, key=lambda p: len(p.neighbors))
+        origin.gossip("one-hop")
+        await asyncio.sleep(TIMING.gossip_period * 4)
+        nbrs = set(origin.neighbors)
+        for p in peers:
+            if p is origin:
+                continue
+            if p.addr in nbrs:
+                assert p.has_seen("one-hop")
+            else:
+                assert not p.has_seen("one-hop")
+    finally:
+        await stop_all(seeds, peers)

@@ -1,0 +1,71 @@
+"""Wire codec round-trips + classification (SURVEY.md §2.4 catalog)."""
+
+import pickle
+
+import pytest
+
+from tpu_gossip.compat import wire
+
+
+ADDR = ("127.0.0.1", 5000)
+
+
+def test_peer_handshake_roundtrip():
+    raw = wire.encode_peer_handshake(ADDR)
+    assert raw == b"('127.0.0.1', 5000)\n"  # exact reference format (Peer.py:95-97)
+    assert wire.decode_peer_handshake(raw.decode()) == ADDR
+
+
+def test_seed_handshake_roundtrip():
+    raw = wire.encode_seed_handshake(ADDR)
+    assert raw.startswith(b"I am seed|")
+    assert wire.decode_seed_handshake(raw.decode()) == ADDR
+
+
+def test_subset_roundtrip_with_trailing_bytes():
+    subset = [("127.0.0.1", 5000), ("10.0.0.2", 6000)]
+    raw = wire.encode_subset(subset)
+    assert raw.endswith(b"\n")
+    # §2.6.9: trailing bytes after the pickle are ignored
+    assert wire.decode_subset(raw + b"Heartbeat from ('x', 1)\n") == subset
+
+
+def test_subset_rejects_malicious_pickle():
+    evil = pickle.dumps(ValueError)  # any global reference must be refused
+    with pytest.raises(pickle.UnpicklingError):
+        wire.decode_subset(evil)
+
+
+def test_new_node_update_roundtrip():
+    subset = [("a", 1), ("b", 2)]
+    raw = wire.encode_new_node_update(ADDR, subset)
+    peer, got = wire.decode_new_node_update(raw.decode())
+    assert peer == ADDR and got == subset
+
+
+def test_heartbeat_roundtrip():
+    raw = wire.encode_heartbeat(ADDR)
+    assert raw == b"Heartbeat from ('127.0.0.1', 5000)\n"
+    assert wire.decode_heartbeat(raw.decode()) == ADDR
+
+
+def test_dead_node_roundtrip():
+    raw = wire.encode_dead_node(ADDR)
+    assert raw == b"Dead Node: ('127.0.0.1', 5000)\n"
+    assert wire.decode_dead_node(raw.decode()) == ADDR
+
+
+@pytest.mark.parametrize(
+    "line,kind",
+    [
+        ("PING", "ping"),
+        ("I am seed|('a', 1)", "seed_handshake"),
+        ("Heartbeat from ('a', 1)", "heartbeat"),
+        ("Dead Node: ('a', 1)", "dead_node"),
+        ("NewNodeUpdate|('a', 1)|[('b', 2)]", "new_node_update"),
+        ("2025-01-01 00:00:00:127.0.0.1:3", "gossip_or_text"),
+        ("", "empty"),
+    ],
+)
+def test_classify(line, kind):
+    assert wire.classify(line)[0] == kind

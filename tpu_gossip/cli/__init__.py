@@ -5,3 +5,29 @@ The reference's CLI is two interactive scripts prompting for a port on stdin
 batched tpu-sim transport; `run_seed`/`run_peer` run socket-compatible
 nodes (compat layer) with proper argparse flags instead of prompts.
 """
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+
+
+def stdin_queue(loop: asyncio.AbstractEventLoop) -> asyncio.Queue:
+    """Feed stdin lines into an asyncio queue from a daemon thread.
+
+    A daemon thread (not run_in_executor) so asyncio.run's shutdown never
+    joins a thread blocked in readline — otherwise --run-seconds exits hang
+    until the operator presses Enter. EOF enqueues None once.
+    """
+    q: asyncio.Queue = asyncio.Queue()
+
+    def pump() -> None:
+        while True:
+            line = sys.stdin.readline()
+            loop.call_soon_threadsafe(q.put_nowait, line if line else None)
+            if not line:
+                return
+
+    threading.Thread(target=pump, daemon=True).start()
+    return q

@@ -1,0 +1,18 @@
+"""Socket-compatibility layer: the reference's process-per-node protocol.
+
+Reproduces the reference's capability surface — seed registry bootstrap from
+``config.txt``, quorum registration, rendezvous turn-taking, push gossip,
+heartbeat/PING liveness, dead-node purge — over asyncio (one event loop per
+node instead of the reference's thread-per-connection, SURVEY.md §1), with
+the wire formats of SURVEY.md §2.4 and the timing contract of §2.5.
+
+``transport="socket"`` runs real TCP nodes; ``transport="tpu-sim"`` backs
+the same PeerNode/SeedNode API with the batched device engine (the
+BASELINE.json north-star flag).
+"""
+
+from tpu_gossip.compat.timing import ProtocolTiming
+from tpu_gossip.compat.peer import PeerNode
+from tpu_gossip.compat.seed import SeedNode
+
+__all__ = ["PeerNode", "SeedNode", "ProtocolTiming"]

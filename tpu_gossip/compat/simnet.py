@@ -1,0 +1,152 @@
+"""SimCluster: the tpu-sim transport backend behind the PeerNode API.
+
+The north-star requirement (BASELINE.json): the same Peer/Seed surface, but
+the per-process socket loop replaced by the batched device engine. A
+SimCluster plays the *seed* role host-side (topology construction = the
+power-law subset policy, executed once as a graph build instead of per-
+registration handouts) and runs all peers as rows of a
+:class:`~tpu_gossip.core.state.SwarmState`. One ``step()`` is one protocol
+round for every peer at once (gossip fan-out + dedup + liveness), replacing
+wall-clock timers with the round mapping of SURVEY.md §7.4.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from tpu_gossip.compat.wire import Addr
+from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm, message_slot
+from tpu_gossip.core.topology import build_csr, preferential_attachment
+from tpu_gossip.sim.engine import simulate
+
+__all__ = ["SimCluster"]
+
+
+class SimCluster:
+    """Hosts a whole swarm of tpu-sim PeerNodes.
+
+    Usage::
+
+        cluster = SimCluster(msg_slots=64, fanout=3)
+        peers = [PeerNode("10.0.0.%d" % i, 9000, transport="tpu-sim",
+                          cluster=cluster) for i in range(1000)]
+        cluster.materialize(m=3)          # power-law topology, one build
+        peers[0].gossip("hello")          # infect origin
+        cluster.step(20)                  # 20 batched rounds
+        peers[999].has_seen("hello")      # -> True
+    """
+
+    def __init__(
+        self,
+        *,
+        msg_slots: int = 64,
+        fanout: int = 3,
+        mode: str = "push",
+        seed: int = 0,
+        **config_kw,
+    ) -> None:
+        self._addrs: list[Addr] = []
+        self._ids: dict[Addr, int] = {}
+        self._msg_slots = msg_slots
+        self._fanout = fanout
+        self._mode = mode
+        self._seed = seed
+        self._config_kw = config_kw
+        self._silent_pending: set[Addr] = set()
+        self.cfg: SwarmConfig | None = None
+        self.state: SwarmState | None = None
+        self._graph = None
+
+    # --- registration (the seed role's registry) ---------------------------
+
+    def register_peer(self, addr: Addr) -> int:
+        if addr in self._ids:
+            raise ValueError(f"duplicate peer {addr}")
+        if self.state is not None:
+            raise RuntimeError("cluster already materialized; register first")
+        self._ids[addr] = len(self._addrs)
+        self._addrs.append(addr)
+        return self._ids[addr]
+
+    @property
+    def n_peers(self) -> int:
+        return len(self._addrs)
+
+    def materialize(self, *, m: int = 3) -> None:
+        """Build the power-law topology (preferential attachment, the
+        intended semantics of reference Seed.py:151-185) and device state."""
+        n = len(self._addrs)
+        if n < m + 2:
+            raise ValueError(f"need at least {m + 2} peers, have {n}")
+        rng = np.random.default_rng(self._seed)
+        self._graph = build_csr(n, preferential_attachment(n, m=m, rng=rng))
+        self.cfg = SwarmConfig(
+            n_peers=n,
+            msg_slots=self._msg_slots,
+            fanout=self._fanout,
+            mode=self._mode,
+            **self._config_kw,
+        )
+        self.state = init_swarm(self._graph, self.cfg, key=jax.random.key(self._seed))
+        for addr in self._silent_pending:
+            self.set_silent(addr, True)
+
+    def _require_state(self) -> SwarmState:
+        if self.state is None:
+            raise RuntimeError("call materialize() first")
+        return self.state
+
+    def _id(self, addr: Addr) -> int:
+        return self._ids[addr]
+
+    # --- the PeerNode-facing API -------------------------------------------
+
+    def gossip(self, addr: Addr, text: str) -> None:
+        st = self._require_state()
+        slot = message_slot(text, self._msg_slots)
+        i = self._id(addr)
+        st.seen = st.seen.at[i, slot].set(True)
+        # record first-infection round unless already infected (-1 = never;
+        # engine gates SIR recovery on infected_round >= 0)
+        if int(st.infected_round[i]) < 0:
+            st.infected_round = st.infected_round.at[i].set(int(st.round))
+
+    def has_seen(self, addr: Addr, text: str) -> bool:
+        st = self._require_state()
+        slot = message_slot(text, self._msg_slots)
+        return bool(st.seen[self._id(addr), slot])
+
+    def set_silent(self, addr: Addr, value: bool) -> None:
+        if self.state is None:
+            (self._silent_pending.add if value else self._silent_pending.discard)(addr)
+            return
+        self.state.silent = self.state.silent.at[self._id(addr)].set(value)
+
+    def kill(self, addr: Addr) -> None:
+        """Crash a peer (connection-dropping death, vs silent-mode)."""
+        st = self._require_state()
+        st.alive = st.alive.at[self._id(addr)].set(False)
+
+    def is_declared_dead(self, addr: Addr) -> bool:
+        st = self._require_state()
+        return bool(st.declared_dead[self._id(addr)])
+
+    def neighbors(self, addr: Addr) -> list[Addr]:
+        if self._graph is None:
+            raise RuntimeError("call materialize() first")
+        return sorted(self._addrs[j] for j in self._graph.neighbors(self._id(addr)))
+
+    # --- round loop ---------------------------------------------------------
+
+    def step(self, rounds: int = 1):
+        """Advance every peer ``rounds`` protocol rounds (batched on device);
+        returns stacked per-round RoundStats (fields shaped (rounds,))."""
+        st = self._require_state()
+        self.state, stats = simulate(st, self.cfg, rounds)
+        return stats
+
+    def coverage(self, text: str) -> float:
+        st = self._require_state()
+        slot = message_slot(text, self._msg_slots)
+        return float(st.coverage(slot))
