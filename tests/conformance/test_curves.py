@@ -1,0 +1,130 @@
+"""Conformance: socket-transport coverage curves vs the tpu-sim engine on
+the SAME graph (BASELINE.json north star: "coverage-vs-round curves matching
+the socket baseline").
+
+Both transports run identical push-gossip semantics — every round/tick, each
+infected peer pushes what it has seen to `fanout` uniformly sampled
+neighbors — over one fixed preferential-attachment graph. The curves are
+stochastic (independent RNGs), so we compare rounds-to-X% within a
+tolerance, not traces (SURVEY.md §7.4 "matching distributions, not traces").
+"""
+
+import asyncio
+import functools
+import socket as socketlib
+
+import numpy as np
+
+from tpu_gossip.compat.peer import PeerNode
+from tpu_gossip.compat.simnet import SimCluster
+from tpu_gossip.compat.timing import ProtocolTiming
+from tpu_gossip.core.topology import build_csr, preferential_attachment
+
+N = 40
+FANOUT = 3
+TICK = 0.08  # socket gossip period (seconds per round)
+
+
+def asyncio_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        return asyncio.run(fn(*a, **kw))
+
+    return wrapper
+
+
+def fixed_graph():
+    return build_csr(N, preferential_attachment(N, m=3, use_native=False,
+                                                rng=np.random.default_rng(42)))
+
+
+def free_ports(n):
+    socks = [socketlib.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def socket_curve(graph, origin: int, rounds: int, tmp_path) -> np.ndarray:
+    """Round-gated push gossip over real sockets on the given graph."""
+    timing = ProtocolTiming(
+        gossip_period=TICK, heartbeat_period=10.0, detect_period=10.0,
+        heartbeat_timeout=60.0,
+    )
+    ports = free_ports(N)
+    addrs = [("127.0.0.1", p) for p in ports]
+    peers = [
+        PeerNode(*a, timing=timing, relay_mode="rounds", fanout=FANOUT,
+                 log_dir=str(tmp_path))
+        for a in addrs
+    ]
+    for p in peers:
+        await p.start_detached()
+    for i, p in enumerate(peers):
+        await p.connect_to([addrs[j] for j in graph.neighbors(i) if j > i])
+    await asyncio.sleep(TICK)
+
+    peers[origin].gossip("conformance-msg")
+    curve = []
+    for _ in range(rounds):
+        await asyncio.sleep(TICK)
+        curve.append(sum(p.has_seen("conformance-msg") for p in peers) / N)
+    for p in peers:
+        await p.stop()
+    return np.asarray(curve)
+
+
+def sim_curve(graph, origin: int, rounds: int, seed: int) -> np.ndarray:
+    """Per-round coverage of the message's hash slot on the tpu-sim engine."""
+    cluster = SimCluster(msg_slots=8, fanout=FANOUT, seed=seed)
+    peers = [
+        PeerNode("10.0.0.1", 9000 + i, transport="tpu-sim", cluster=cluster)
+        for i in range(N)
+    ]
+    cluster.materialize(graph=graph)
+    peers[origin].gossip("conformance-msg")
+    curve = []
+    for _ in range(rounds):
+        cluster.step(1)
+        curve.append(cluster.coverage("conformance-msg"))
+    return np.asarray(curve)
+
+
+def rounds_to(curve: np.ndarray, frac: float) -> int:
+    hit = np.nonzero(curve >= frac)[0]
+    return int(hit[0]) + 1 if hit.size else len(curve) + 10
+
+
+@asyncio_test
+async def test_socket_vs_sim_curves_agree(tmp_path):
+    graph = fixed_graph()
+    origin = int(np.argmax(graph.degrees))
+    rounds = 25
+
+    sock = await socket_curve(graph, origin, rounds, tmp_path)
+    sims = [sim_curve(graph, origin, rounds, seed=s) for s in range(3)]
+
+    # both reach (near-)full coverage
+    assert sock[-1] >= 0.99
+    assert all(c[-1] >= 0.99 for c in sims)
+
+    # rounds-to-50% and rounds-to-99% agree within stochastic tolerance
+    sim_r50 = np.median([rounds_to(c, 0.5) for c in sims])
+    sim_r99 = np.median([rounds_to(c, 0.99) for c in sims])
+    assert abs(rounds_to(sock, 0.5) - sim_r50) <= 3
+    assert abs(rounds_to(sock, 0.99) - sim_r99) <= 5
+
+    # same epidemic shape: monotone, and mid-curve values within 0.35
+    mid = slice(2, rounds - 5)
+    assert np.all(np.diff(sock) >= -1e-9)
+    assert np.max(np.abs(sock[mid] - np.mean(sims, axis=0)[mid])) <= 0.35
+
+
+def test_sim_curve_deterministic():
+    graph = fixed_graph()
+    a = sim_curve(graph, 0, 10, seed=7)
+    b = sim_curve(graph, 0, 10, seed=7)
+    np.testing.assert_array_equal(a, b)

@@ -56,6 +56,8 @@ class PeerNode:
         transport: str = "socket",
         cluster=None,  # SimCluster, required for transport="tpu-sim"
         gossip_relay: bool = True,
+        relay_mode: str = "immediate",  # "immediate" | "rounds"
+        fanout: int = 3,  # neighbors per push tick (relay_mode="rounds")
         log_dir: str = ".",
         log_stdout: bool = False,
         on_gossip: Callable[[str], None] | None = None,
@@ -65,6 +67,10 @@ class PeerNode:
         self.timing = timing or ProtocolTiming()
         self.transport = transport
         self.gossip_relay = gossip_relay
+        if relay_mode not in ("immediate", "rounds"):
+            raise ValueError(f"unknown relay_mode {relay_mode!r}")
+        self.relay_mode = relay_mode
+        self.fanout = fanout
         self.silent = False
         self.running = False
         self.on_gossip = on_gossip
@@ -235,8 +241,9 @@ class PeerNode:
         self.log(f"Gossip: {msg_id}")
         if self.on_gossip is not None:
             self.on_gossip(msg_id)
-        if self.gossip_relay:
+        if self.gossip_relay and self.relay_mode == "immediate":
             await self._broadcast_gossip(msg_id, exclude=from_conn)
+        # relay_mode="rounds": _push_tick_loop handles dissemination
 
     async def _broadcast_gossip(self, line: str, exclude: _Conn | None = None) -> None:
         data = (line + "\n").encode()
@@ -272,7 +279,31 @@ class PeerNode:
             return
         self.seen_messages.add(text)
         self.gossip_log.append(text)
-        asyncio.ensure_future(self._broadcast_gossip(text))
+        if self.relay_mode == "immediate":
+            asyncio.ensure_future(self._broadcast_gossip(text))
+        # rounds mode: the next push tick disseminates it
+
+    async def _push_tick_loop(self) -> None:
+        """Round-gated push gossip: every gossip_period, push everything seen
+        to ``fanout`` uniformly sampled neighbors — the socket-side twin of
+        the engine's push round (sim/engine.py), used for coverage-curve
+        conformance between the two transports (BASELINE north star)."""
+        import random as _random
+
+        rng = _random.Random(self.addr[1])
+        while self.running:
+            await asyncio.sleep(self.timing.gossip_period)
+            conns = list(self.out_conns.values()) + list(self.in_conns.values())
+            if not conns or not self.seen_messages:
+                continue
+            for msg in list(self.seen_messages):
+                data = (msg + "\n").encode()
+                for conn in rng.choices(conns, k=min(self.fanout, len(conns))):
+                    try:
+                        conn.writer.write(data)
+                        await conn.writer.drain()
+                    except (ConnectionError, OSError):
+                        pass
 
     # --- liveness (Peer.py:298-393) ----------------------------------------
 
@@ -324,6 +355,24 @@ class PeerNode:
 
     # --- lifecycle ----------------------------------------------------------
 
+    async def start_detached(self) -> None:
+        """Start server + protocol loops WITHOUT seed bootstrap — for
+        harnesses that wire an explicit topology via :meth:`connect_to`
+        (e.g. the socket-vs-tpu-sim conformance runs on a fixed graph)."""
+        self.running = True
+        self._server = await asyncio.start_server(self._on_peer_connection, *self.addr)
+        self._subset_received = True
+        self._tasks += [
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._detector_loop()),
+        ]
+        if self.gossip_relay and self.relay_mode == "rounds":
+            self._tasks.append(asyncio.ensure_future(self._push_tick_loop()))
+
+    async def connect_to(self, peers: list[Addr]) -> None:
+        """Dial the given peers directly (harness/topology-injection path)."""
+        await self._connect_to_peers(peers)
+
     async def start(self) -> None:
         if self.transport == "tpu-sim":
             self.running = True
@@ -335,6 +384,8 @@ class PeerNode:
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._detector_loop()),
         ]
+        if self.gossip_relay and self.relay_mode == "rounds":
+            self._tasks.append(asyncio.ensure_future(self._push_tick_loop()))
         self.log(f"Peer up on {self.addr}")
 
     async def stop(self) -> None:

@@ -73,14 +73,24 @@ class SimCluster:
     def n_peers(self) -> int:
         return len(self._addrs)
 
-    def materialize(self, *, m: int = 3) -> None:
+    def materialize(self, *, m: int = 3, graph=None) -> None:
         """Build the power-law topology (preferential attachment, the
-        intended semantics of reference Seed.py:151-185) and device state."""
+        intended semantics of reference Seed.py:151-185) and device state.
+
+        Pass ``graph`` (a :class:`~tpu_gossip.core.topology.Graph` over the
+        registered peers, e.g. from ``load_graph``) to run an externally
+        fixed topology — the conformance path where socket-mode and tpu-sim
+        execute the SAME graph (SURVEY.md §7.4)."""
         n = len(self._addrs)
-        if n < m + 2:
+        if graph is not None:
+            if graph.n != n:
+                raise ValueError(f"graph has {graph.n} nodes, {n} peers registered")
+            self._graph = graph
+        elif n < m + 2:
             raise ValueError(f"need at least {m + 2} peers, have {n}")
-        rng = np.random.default_rng(self._seed)
-        self._graph = build_csr(n, preferential_attachment(n, m=m, rng=rng))
+        else:
+            rng = np.random.default_rng(self._seed)
+            self._graph = build_csr(n, preferential_attachment(n, m=m, rng=rng))
         self.cfg = SwarmConfig(
             n_peers=n,
             msg_slots=self._msg_slots,

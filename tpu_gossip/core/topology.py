@@ -42,6 +42,8 @@ __all__ = [
     "build_csr",
     "edges_to_adjacency_sets",
     "fit_powerlaw_gamma",
+    "save_graph",
+    "load_graph",
 ]
 
 
@@ -196,6 +198,21 @@ def build_csr(n: int, edges: np.ndarray) -> Graph:
     row_ptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(counts, out=row_ptr[1:])
     return Graph(n=n, row_ptr=row_ptr.astype(np.int32), col_idx=dst.astype(np.int32))
+
+
+def save_graph(path, graph: Graph) -> None:
+    """Seeded graph export so socket-mode and tpu-sim runs can execute the
+    SAME topology (conformance requirement, SURVEY.md §7.4)."""
+    np.savez(path, n=graph.n, row_ptr=graph.row_ptr, col_idx=graph.col_idx)
+
+
+def load_graph(path) -> Graph:
+    data = np.load(path)
+    return Graph(
+        n=int(data["n"]),
+        row_ptr=data["row_ptr"].astype(np.int32),
+        col_idx=data["col_idx"].astype(np.int32),
+    )
 
 
 def edges_to_adjacency_sets(edges: np.ndarray) -> dict[int, set[int]]:
