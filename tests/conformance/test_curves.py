@@ -7,6 +7,12 @@ infected peer pushes what it has seen to `fanout` uniformly sampled
 neighbors — over one fixed preferential-attachment graph. The curves are
 stochastic (independent RNGs), so we compare rounds-to-X% within a
 tolerance, not traces (SURVEY.md §7.4 "matching distributions, not traces").
+
+The socket side is BARRIER-STEPPED (relay_mode="manual" + an explicit
+drain between rounds): a "round" is exactly one push_tick per peer, never a
+wall-clock bin, so the curve cannot run ahead of the sim under machine load
+(the round-1 flake: free-running ticks let several relay hops land in one
+0.08 s bin).
 """
 
 import asyncio
@@ -48,8 +54,24 @@ def free_ports(n):
     return ports
 
 
+async def drain(peers, msg: str, settle: float = 0.01, timeout: float = 2.0) -> None:
+    """Wait until the per-round coverage stops changing: all in-flight writes
+    from this barrier's push_ticks have been read and counted. Requires 3
+    consecutive stable polls so a briefly starved reader coroutine (loaded
+    machine) doesn't fake quiescence."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    prev, stable = -1, 0
+    while asyncio.get_event_loop().time() < deadline:
+        cur = sum(p.has_seen(msg) for p in peers)
+        stable = stable + 1 if cur == prev else 0
+        if stable >= 3:
+            return
+        prev = cur
+        await asyncio.sleep(settle)
+
+
 async def socket_curve(graph, origin: int, rounds: int, tmp_path) -> np.ndarray:
-    """Round-gated push gossip over real sockets on the given graph."""
+    """Barrier-stepped push gossip over real sockets on the given graph."""
     timing = ProtocolTiming(
         gossip_period=TICK, heartbeat_period=10.0, detect_period=10.0,
         heartbeat_timeout=60.0,
@@ -57,7 +79,7 @@ async def socket_curve(graph, origin: int, rounds: int, tmp_path) -> np.ndarray:
     ports = free_ports(N)
     addrs = [("127.0.0.1", p) for p in ports]
     peers = [
-        PeerNode(*a, timing=timing, relay_mode="rounds", fanout=FANOUT,
+        PeerNode(*a, timing=timing, relay_mode="manual", fanout=FANOUT,
                  log_dir=str(tmp_path))
         for a in addrs
     ]
@@ -70,7 +92,14 @@ async def socket_curve(graph, origin: int, rounds: int, tmp_path) -> np.ndarray:
     peers[origin].gossip("conformance-msg")
     curve = []
     for _ in range(rounds):
-        await asyncio.sleep(TICK)
+        # barrier: snapshot every peer's seen-set first (simultaneous-round
+        # semantics — receipts during the barrier relay next round), then
+        # exactly one push tick per peer, then settle so every write issued
+        # this round is received before the next round starts
+        snaps = [list(p.seen_messages) for p in peers]
+        for p, snap in zip(peers, snaps):
+            await p.push_tick(snap)
+        await drain(peers, "conformance-msg")
         curve.append(sum(p.has_seen("conformance-msg") for p in peers) / N)
     for p in peers:
         await p.stop()

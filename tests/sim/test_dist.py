@@ -82,6 +82,32 @@ def test_pad_slots_stay_dead(setup):
     assert not seen[sg.n :].any()  # pads never receive
 
 
+def test_churn_never_resurrects_pad_slots():
+    """Rejoin sampling must exclude pad slots (exists=False): with 30 peers on
+    8 shards (2 pads) and aggressive join probability, pads must stay dead —
+    otherwise they dilute the coverage denominator (caps at 30/32) and
+    run-to-coverage spins to max_rounds."""
+    n = 30
+    g = build_csr(n, preferential_attachment(n, m=3, use_native=False))
+    mesh = make_mesh(8)
+    sg, relabeled, position = partition_graph(g, 8, seed=0)
+    # join-only churn: the pads are the ONLY vacant slots, so any rejoin
+    # that fires is exactly the resurrection bug
+    cfg = SwarmConfig(
+        n_peers=sg.n_pad, msg_slots=4, mode="push", fanout=2,
+        churn_join_prob=0.9,
+    )
+    st = shard_swarm(init_sharded_swarm(sg, relabeled, position, cfg, origins=[0]), mesh)
+    fin, _ = simulate_dist(st, cfg, sg, mesh, 30)
+    exists = np.asarray(fin.exists)
+    alive = np.asarray(fin.alive)
+    assert exists.sum() == n
+    assert not alive[~exists].any(), "pad slots were resurrected by churn rejoin"
+    # denominator excludes pads, so full coverage is reachable (was capped
+    # at 30/32 with resurrected degree-0 pads)
+    assert float(fin.coverage(0)) >= 0.99
+
+
 def test_liveness_dist(setup):
     """Silent-peer detection must work identically under sharding."""
     _, mesh, sg, relabeled, position = setup

@@ -67,6 +67,28 @@ def test_checkpoint_roundtrip(tmp_path):
     assert bool(jnp.array_equal(jax.random.key_data(st2.rng), jax.random.key_data(st.rng)))
 
 
+def test_legacy_v1_checkpoint_loads(tmp_path):
+    """Round-1 checkpoints used positional arr_i/key_i keys and predate the
+    `exists` field — they must still load, with exists defaulting to ones."""
+    from tpu_gossip.core.state import _V1_FIELDS, load_swarm
+
+    g = small_graph(32)
+    st = init_swarm(g, SwarmConfig(n_peers=32), origins=[2])
+    arrays = {}
+    for i, name in enumerate(_V1_FIELDS):
+        leaf = getattr(st, name)
+        if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            arrays[f"key_{i}"] = np.asarray(jax.random.key_data(leaf))
+        else:
+            arrays[f"arr_{i}"] = np.asarray(leaf)
+    np.savez(tmp_path / "v1.npz", **arrays)
+
+    st2 = load_swarm(tmp_path / "v1.npz")
+    assert bool(jnp.array_equal(st2.seen, st.seen))
+    assert bool(jnp.array_equal(st2.alive, st.alive))
+    assert bool(st2.exists.all()) and st2.exists.shape == st.alive.shape
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         SwarmConfig(n_peers=0)

@@ -56,7 +56,7 @@ class PeerNode:
         transport: str = "socket",
         cluster=None,  # SimCluster, required for transport="tpu-sim"
         gossip_relay: bool = True,
-        relay_mode: str = "immediate",  # "immediate" | "rounds"
+        relay_mode: str = "immediate",  # "immediate" | "rounds" | "manual" (external push_tick)
         fanout: int = 3,  # neighbors per push tick (relay_mode="rounds")
         log_dir: str = ".",
         log_stdout: bool = False,
@@ -67,9 +67,10 @@ class PeerNode:
         self.timing = timing or ProtocolTiming()
         self.transport = transport
         self.gossip_relay = gossip_relay
-        if relay_mode not in ("immediate", "rounds"):
+        if relay_mode not in ("immediate", "rounds", "manual"):
             raise ValueError(f"unknown relay_mode {relay_mode!r}")
         self.relay_mode = relay_mode
+        self._tick_rng = None  # lazy per-peer RNG for push_tick
         self.fanout = fanout
         self.silent = False
         self.running = False
@@ -243,7 +244,8 @@ class PeerNode:
             self.on_gossip(msg_id)
         if self.gossip_relay and self.relay_mode == "immediate":
             await self._broadcast_gossip(msg_id, exclude=from_conn)
-        # relay_mode="rounds": _push_tick_loop handles dissemination
+        # relay_mode="rounds": _push_tick_loop handles dissemination;
+        # relay_mode="manual": the harness drives push_tick() itself
 
     async def _broadcast_gossip(self, line: str, exclude: _Conn | None = None) -> None:
         data = (line + "\n").encode()
@@ -283,27 +285,41 @@ class PeerNode:
             asyncio.ensure_future(self._broadcast_gossip(text))
         # rounds mode: the next push tick disseminates it
 
-    async def _push_tick_loop(self) -> None:
-        """Round-gated push gossip: every gossip_period, push everything seen
-        to ``fanout`` uniformly sampled neighbors — the socket-side twin of
-        the engine's push round (sim/engine.py), used for coverage-curve
-        conformance between the two transports (BASELINE north star)."""
-        import random as _random
+    async def push_tick(self, messages: list[str] | None = None) -> None:
+        """ONE round of round-gated push gossip: push everything seen to
+        ``fanout`` uniformly sampled neighbors — the socket-side twin of the
+        engine's push round (sim/engine.py). Driven by :meth:`_push_tick_loop`
+        on a wall-clock cadence (relay_mode="rounds"), or externally by a
+        barrier-stepping harness (relay_mode="manual") so a "round" is an
+        exact barrier rather than a wall-clock bin (conformance tests).
 
-        rng = _random.Random(self.addr[1])
+        ``messages`` lets the harness pass a seen-set snapshot taken at the
+        barrier start, so messages received DURING the barrier are not
+        relayed until the next round (simultaneous-round semantics, matching
+        the engine where all peers push state as of round start)."""
+        if self._tick_rng is None:
+            import random as _random
+
+            self._tick_rng = _random.Random(self.addr[1])
+        rng = self._tick_rng
+        conns = list(self.out_conns.values()) + list(self.in_conns.values())
+        if messages is None:
+            messages = list(self.seen_messages)
+        if not conns or not messages:
+            return
+        for msg in messages:
+            data = (msg + "\n").encode()
+            for conn in rng.choices(conns, k=min(self.fanout, len(conns))):
+                try:
+                    conn.writer.write(data)
+                    await conn.writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+
+    async def _push_tick_loop(self) -> None:
         while self.running:
             await asyncio.sleep(self.timing.gossip_period)
-            conns = list(self.out_conns.values()) + list(self.in_conns.values())
-            if not conns or not self.seen_messages:
-                continue
-            for msg in list(self.seen_messages):
-                data = (msg + "\n").encode()
-                for conn in rng.choices(conns, k=min(self.fanout, len(conns))):
-                    try:
-                        conn.writer.write(data)
-                        await conn.writer.drain()
-                    except (ConnectionError, OSError):
-                        pass
+            await self.push_tick()
 
     # --- liveness (Peer.py:298-393) ----------------------------------------
 

@@ -92,6 +92,7 @@ class SwarmState:
     infected_round: jax.Array  # int32 (N,) — round of first infection (SIR; -1 = never)
     recovered: jax.Array  # bool (N,) — SIR removed state
     # liveness
+    exists: jax.Array  # bool (N,) — static: slot is a real peer (False: pad/sentinel)
     alive: jax.Array  # bool (N,) — crashed/departed = False
     silent: jax.Array  # bool (N,) — fault injection: no heartbeats / PING replies
     last_hb: jax.Array  # int32 (N,) — round of last emitted heartbeat
@@ -111,41 +112,48 @@ class SwarmState:
         return jnp.sum(self.seen[:, slot] & live) / n_live
 
 
+# field order of the round-1 checkpoint format (positional arr_i/key_i keys,
+# before the `exists` field existed) — kept for legacy loads
+_V1_FIELDS = (
+    "row_ptr", "col_idx", "seen", "forwarded", "infected_round", "recovered",
+    "alive", "silent", "last_hb", "declared_dead", "rng", "round",
+)
+
+
 def save_swarm(path, state: SwarmState) -> None:
     """Checkpoint the swarm (reference has none — SURVEY.md §5.4; the whole
-    simulation state is one pytree, so resume is lossless)."""
-    flat, _ = jax.tree_util.tree_flatten(state)
+    simulation state is one pytree, so resume is lossless). Arrays are keyed
+    by FIELD NAME so the format survives adding/reordering state fields."""
     arrays = {}
-    for i, leaf in enumerate(flat):
+    for f in dataclasses.fields(SwarmState):
+        leaf = getattr(state, f.name)
         if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
-            arrays[f"key_{i}"] = np.asarray(jax.random.key_data(leaf))
+            arrays[f"prngkey_{f.name}"] = np.asarray(jax.random.key_data(leaf))
         else:
-            arrays[f"arr_{i}"] = np.asarray(leaf)
+            arrays[f"field_{f.name}"] = np.asarray(leaf)
     np.savez(path, **arrays)
 
 
 def load_swarm(path) -> SwarmState:
-    """Restore a :func:`save_swarm` checkpoint."""
+    """Restore a :func:`save_swarm` checkpoint (named-field format, with a
+    fallback for round-1 positional checkpoints: those predate ``exists``,
+    which defaults to all-True — correct for their unpadded swarms)."""
     data = np.load(path)
-    _, treedef = jax.tree_util.tree_flatten(_template())
-    leaves = []
-    for i in range(len(dataclasses.fields(SwarmState))):
-        if f"key_{i}" in data:
-            leaves.append(jax.random.wrap_key_data(jnp.asarray(data[f"key_{i}"])))
-        else:
-            leaves.append(jnp.asarray(data[f"arr_{i}"]))
-    return jax.tree_util.tree_unflatten(treedef, leaves)
-
-
-def _template() -> SwarmState:
-    """Minimal state used only for its treedef (field order)."""
-    z = jnp.zeros((1,), dtype=jnp.int32)
-    b = jnp.zeros((1,), dtype=bool)
-    return SwarmState(
-        row_ptr=z, col_idx=z, seen=b[None], forwarded=b[None],
-        infected_round=z, recovered=b, alive=b, silent=b, last_hb=z,
-        declared_dead=b, rng=jax.random.key(0), round=jnp.asarray(0, jnp.int32),
-    )
+    kwargs = {}
+    if any(k.startswith("field_") or k.startswith("prngkey_") for k in data.files):
+        for f in dataclasses.fields(SwarmState):
+            if f"prngkey_{f.name}" in data:
+                kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
+            else:
+                kwargs[f.name] = jnp.asarray(data[f"field_{f.name}"])
+    else:  # legacy positional layout
+        for i, name in enumerate(_V1_FIELDS):
+            if f"key_{i}" in data:
+                kwargs[name] = jax.random.wrap_key_data(jnp.asarray(data[f"key_{i}"]))
+            else:
+                kwargs[name] = jnp.asarray(data[f"arr_{i}"])
+        kwargs["exists"] = jnp.ones(kwargs["alive"].shape, dtype=bool)
+    return SwarmState(**kwargs)
 
 
 def message_slot(message_id: int | str, msg_slots: int) -> int:
@@ -189,6 +197,7 @@ def init_swarm(
         forwarded=jnp.zeros((n, m), dtype=bool),
         infected_round=jnp.asarray(infected_round),
         recovered=jnp.zeros((n,), dtype=bool),
+        exists=jnp.ones((n,), dtype=bool),
         alive=jnp.ones((n,), dtype=bool),
         silent=jnp.zeros((n,), dtype=bool),
         last_hb=jnp.zeros((n,), dtype=jnp.int32),

@@ -182,6 +182,7 @@ def init_sharded_swarm(
         pad = np.zeros(sg.n_pad, dtype=bool)
         pad[sg.n :] = True
         pad = jnp.asarray(pad)
+        state.exists = state.exists & ~pad
         state.alive = state.alive & ~pad
         state.declared_dead = state.declared_dead | pad
     return state

@@ -190,7 +190,10 @@ def advance_round(
         # vacant slots rejoin with fresh protocol state; their edges were
         # preallocated at graph build (jit-friendly churn, SURVEY.md §7.4:
         # fixed slots + alive masks instead of per-round CSR rebuilds).
-        join = (~alive) & (
+        # Pad/sentinel slots (exists=False) never rejoin — they are not
+        # peers, and resurrecting them would dilute the coverage
+        # denominator with uninfectable degree-0 slots.
+        join = (~alive) & state.exists & (
             jax.random.uniform(k_join, alive.shape) < cfg.churn_join_prob
         )
         alive = alive | join
@@ -210,6 +213,7 @@ def advance_round(
         forwarded=forwarded,
         infected_round=infected_round,
         recovered=recovered,
+        exists=state.exists,
         alive=alive,
         silent=silent,
         last_hb=last_hb,
