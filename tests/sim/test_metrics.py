@@ -31,7 +31,7 @@ def test_rounds_to_coverage(setup):
 
 def test_bench_swarm_agrees_with_curve(setup):
     cfg, st = setup
-    res = M.bench_swarm(st, cfg, 0.99, 200)
+    res, _fin = M.bench_swarm(st, cfg, 0.99, 200)
     _, stats = simulate(st, cfg, res.rounds)
     assert float(np.asarray(stats.coverage)[-1]) >= 0.99
     assert res.coverage >= 0.99

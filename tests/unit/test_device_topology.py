@@ -1,0 +1,97 @@
+"""On-device graph construction: statistical + structural parity with the
+host erased configuration model (core/topology.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip.core.device_topology import (
+    device_powerlaw_graph,
+    truncated_pareto_mean,
+)
+from tpu_gossip.core.topology import (
+    build_csr,
+    configuration_model,
+    fit_powerlaw_gamma,
+    powerlaw_degree_sequence,
+)
+
+N = 20_000
+
+
+@pytest.fixture(scope="module")
+def dg():
+    return device_powerlaw_graph(N, gamma=2.5, key=jax.random.key(7))
+
+
+def test_structure_is_a_clean_graph(dg):
+    g = dg.to_host_graph()
+    assert g.n == N
+    deg = g.degrees
+    for i in np.random.default_rng(0).integers(0, N, 200):
+        nb = g.neighbors(int(i))
+        assert len(set(nb.tolist())) == len(nb), "duplicate neighbor survived"
+        assert int(i) not in nb, "self-loop survived"
+    # symmetry on a sample
+    for i in np.random.default_rng(1).integers(0, N, 50):
+        for j in g.neighbors(int(i))[:5]:
+            assert int(i) in g.neighbors(int(j))
+    # sentinel row owns all invalid slots; real rows own the rest
+    total = int(np.asarray(dg.row_ptr)[-1])
+    assert total == dg.col_idx.shape[0]
+    assert int(np.asarray(dg.row_ptr)[N]) == deg.sum()
+
+
+def test_degree_law_matches_request(dg):
+    deg = dg.to_host_graph().degrees
+    est = fit_powerlaw_gamma(deg, d_min=5)
+    assert abs(est - 2.5) < 0.3, f"gamma_hat={est}"
+    # erasure removes few edges: mean degree close to the sampled law
+    mean = truncated_pareto_mean(2.5, 2, int(round(N ** (1 / 1.5))))
+    assert deg.mean() == pytest.approx(mean, rel=0.05)
+
+
+def test_parity_with_host_model():
+    """Device and host builders realize the same law: edge counts within a
+    few percent and matching tail exponents on the same parameters."""
+    rng = np.random.default_rng(3)
+    host = build_csr(
+        N, configuration_model(powerlaw_degree_sequence(N, gamma=2.5, rng=rng), rng=rng)
+    )
+    dev = device_powerlaw_graph(N, gamma=2.5, key=jax.random.key(3)).to_host_graph()
+    assert dev.num_edges == pytest.approx(host.num_edges, rel=0.05)
+    assert fit_powerlaw_gamma(dev.degrees, d_min=5) == pytest.approx(
+        fit_powerlaw_gamma(host.degrees, d_min=5), abs=0.25
+    )
+
+
+def test_deterministic_per_key():
+    a = device_powerlaw_graph(2000, key=jax.random.key(5))
+    b = device_powerlaw_graph(2000, key=jax.random.key(5))
+    np.testing.assert_array_equal(np.asarray(a.col_idx), np.asarray(b.col_idx))
+    c = device_powerlaw_graph(2000, key=jax.random.key(6))
+    assert not np.array_equal(np.asarray(a.col_idx), np.asarray(c.col_idx))
+
+
+def test_engine_runs_on_device_graph(dg):
+    """End to end: a swarm initialized straight from the device-built CSR
+    (sentinel row dead via exists) reaches full coverage."""
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.sim.engine import run_until_coverage
+
+    cfg = SwarmConfig(n_peers=dg.n_pad, msg_slots=1, fanout=3, mode="push")
+    st = init_swarm(
+        dg.as_padded_graph(), cfg, origins=[0], exists=dg.exists,
+        key=jax.random.key(1),
+    )
+    fin = run_until_coverage(st, cfg, 0.99, 200)
+    assert float(fin.coverage(0)) >= 0.99
+    assert not bool(fin.seen[N].any())  # sentinel never infected
+    assert not bool(fin.alive[N])
+
+
+def test_exists_masks_only_sentinel(dg):
+    exists = np.asarray(dg.exists)
+    assert exists.shape == (N + 1,)
+    assert exists[:N].all() and not exists[N]
+    assert dg.n_pad == N + 1

@@ -99,14 +99,11 @@ def main(argv: list[str] | None = None) -> int:
             "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
         }
     else:
-        result = M.bench_swarm(state, cfg, args.target, args.max_rounds)
-        fin = None
+        result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds)
         summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
     print(json.dumps(summary))
 
     if args.checkpoint:
-        if fin is None:
-            fin, _ = simulate(state, cfg, 1)
         save_swarm(args.checkpoint, fin)
     return 0
 

@@ -178,27 +178,37 @@ def init_swarm(
     key: jax.Array | None = None,
     origins: np.ndarray | list[int] | None = None,
     origin_slot: int = 0,
+    exists: jax.Array | None = None,
 ) -> SwarmState:
-    """Build device state from a host graph; optionally infect ``origins`` in ``origin_slot``."""
+    """Build device state from a graph; optionally infect ``origins`` in ``origin_slot``.
+
+    ``graph`` may hold host numpy or device arrays (e.g. a
+    ``DeviceGraph``-backed CSR) — per-peer state is constructed on device, so
+    nothing peer-sized crosses the host link. ``exists`` marks real peer
+    slots (default all); non-existent slots (pads/sentinels) start dead.
+    """
     if graph.n != config.n_peers:
         raise ValueError(f"graph has {graph.n} nodes but config.n_peers={config.n_peers}")
     if key is None:
         key = jax.random.key(0)
     n, m = config.n_peers, config.msg_slots
-    seen = np.zeros((n, m), dtype=bool)
-    infected_round = np.full((n,), -1, dtype=np.int32)
+    seen = jnp.zeros((n, m), dtype=bool)
+    infected_round = jnp.full((n,), -1, dtype=jnp.int32)
     if origins is not None:
-        seen[np.asarray(origins), origin_slot] = True
-        infected_round[np.asarray(origins)] = 0
+        origins = jnp.asarray(origins)
+        seen = seen.at[origins, origin_slot].set(True)
+        infected_round = infected_round.at[origins].set(0)
+    if exists is None:
+        exists = jnp.ones((n,), dtype=bool)
     return SwarmState(
         row_ptr=jnp.asarray(graph.row_ptr, dtype=jnp.int32),
         col_idx=jnp.asarray(graph.col_idx, dtype=jnp.int32),
-        seen=jnp.asarray(seen),
+        seen=seen,
         forwarded=jnp.zeros((n, m), dtype=bool),
-        infected_round=jnp.asarray(infected_round),
+        infected_round=infected_round,
         recovered=jnp.zeros((n,), dtype=bool),
-        exists=jnp.ones((n,), dtype=bool),
-        alive=jnp.ones((n,), dtype=bool),
+        exists=exists,
+        alive=exists,
         silent=jnp.zeros((n,), dtype=bool),
         last_hb=jnp.zeros((n,), dtype=jnp.int32),
         declared_dead=jnp.zeros((n,), dtype=bool),
