@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--churn-leave", type=float, default=0.0, help="per-round leave probability")
     p.add_argument("--churn-join", type=float, default=0.0, help="per-round rejoin probability")
     p.add_argument("--seed", type=int, default=0, help="RNG seed")
+    p.add_argument(
+        "--staircase",
+        action="store_true",
+        help="flood delivery via the Pallas staircase kernel (mode=flood only)",
+    )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
     return p
@@ -77,6 +82,19 @@ def main(argv: list[str] | None = None) -> int:
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
     )
+    plan = None
+    if args.staircase:
+        if args.mode != "flood":
+            print("--staircase requires --mode flood", file=sys.stderr)
+            return 2
+        if args.slots > 32:
+            print("--staircase packs slots into one int32 word: --slots must be <= 32",
+                  file=sys.stderr)
+            return 2
+        from tpu_gossip.kernels.pallas_segment import build_staircase_plan
+
+        plan = build_staircase_plan(graph.row_ptr, graph.col_idx)
+
     origins = rng.choice(args.peers, size=min(args.origins, args.peers), replace=False)
     state = init_swarm(graph, cfg, key=jax.random.key(args.seed), origins=origins)
     if args.silent_frac > 0:
@@ -85,7 +103,7 @@ def main(argv: list[str] | None = None) -> int:
         state.silent = state.silent.at[silent_ids].set(True)
 
     if args.rounds > 0:
-        fin, stats = simulate(state, cfg, args.rounds)
+        fin, stats = simulate(state, cfg, args.rounds, plan)
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
         rounds = M.rounds_to_coverage(stats, args.target)
@@ -99,7 +117,7 @@ def main(argv: list[str] | None = None) -> int:
             "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
         }
     else:
-        result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds)
+        result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds, plan=plan)
         summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
     print(json.dumps(summary))
 

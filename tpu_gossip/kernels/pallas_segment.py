@@ -1,0 +1,184 @@
+"""Pallas staircase segment-OR: the gossip round's delivery as one TPU kernel.
+
+The north-star formulation (BASELINE.json: "each gossip round ... runs as a
+single Pallas segment-scatter kernel") replaces the reference's per-socket
+send loop (reference Peer.py:395-408) with a segment reduction over the CSR:
+``incoming[i] = OR_{j in N(i)} transmit[j]``. XLA's stock lowering for that
+(``segment_max`` over a (D, M) gather) is slow on TPU — the reduction
+serializes — so this module reformulates it for the MXU:
+
+- Message bitmaps are PACKED into one int32 word per peer (M <= 32 slots).
+- Edges, already destination-grouped by the CSR, are cut into 1024-edge
+  tiles that never cross a 128-row output block boundary (host-side plan,
+  static per graph).
+- Per tile, the kernel unpacks words into M bit-planes, builds the tile's
+  "staircase" one-hot (row r vs per-edge local offset) with an iota
+  compare, and contracts both on the MXU:
+  ``acc[m, r] = sum_e bit_m[e] * (offs[e] == r)`` — a (M,1024)x(1024,128)
+  NT matmul. Tiles of the same output block accumulate through Pallas
+  output-block revisiting (the TPU grid is sequential), so the whole
+  delivery is ONE kernel launch after one XLA gather of packed words.
+
+``segment_or`` == ``kernels.gossip.flood_all`` bit-for-bit (parity-tested);
+the engine uses it for flood-mode dissemination when a plan is supplied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["StaircasePlan", "build_staircase_plan", "pack_words", "unpack_words", "segment_or"]
+
+ROWS = 128  # output rows per block (out block last dim)
+TILE = 1024  # edges per tile, stored (8, 128)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class StaircasePlan:
+    """Static routing tables for one graph (device arrays + static sizes)."""
+
+    tile_block: jax.Array  # int32 (T,) — output block index per tile
+    first_visit: jax.Array  # int32 (T,) — 1 iff first tile of its block
+    offs: jax.Array  # int32 (T*8, 128) — local row offset in [0, ROWS) or -1
+    col_gather: jax.Array  # int32 (T*8, 128) — graph col_idx per edge slot (pad 0)
+    n: int = dataclasses.field(metadata=dict(static=True))
+    n_tiles: int = dataclasses.field(metadata=dict(static=True))
+    n_blocks: int = dataclasses.field(metadata=dict(static=True))
+
+
+def build_staircase_plan(row_ptr: np.ndarray, col_idx: np.ndarray) -> StaircasePlan:
+    """Cut the CSR's destination-grouped edges into MXU tiles (host, once).
+
+    Every 128-row output block gets >= 1 tile (so the kernel zero-initializes
+    every block), and no tile spans two blocks (so accumulation is pure
+    block revisiting).
+    """
+    row_ptr = np.asarray(row_ptr, dtype=np.int64)
+    col_idx = np.asarray(col_idx, dtype=np.int64)
+    n = len(row_ptr) - 1
+    n_blocks = max(1, math.ceil(n / ROWS))
+
+    starts = row_ptr[np.minimum(np.arange(n_blocks) * ROWS, n)]
+    ends = row_ptr[np.minimum((np.arange(n_blocks) + 1) * ROWS, n)]
+    spans = ends - starts
+    tiles_per_block = np.maximum(1, np.ceil(spans / TILE).astype(np.int64))
+    T = int(tiles_per_block.sum())
+
+    tile_block = np.repeat(np.arange(n_blocks, dtype=np.int32), tiles_per_block)
+    first_visit = np.ones(T, dtype=np.int32)
+    first_visit[1:] = tile_block[1:] != tile_block[:-1]
+
+    # per-tile edge spans
+    tile_ord = np.arange(T) - np.repeat(
+        np.cumsum(tiles_per_block) - tiles_per_block, tiles_per_block
+    )
+    tile_start = np.repeat(starts, tiles_per_block) + tile_ord * TILE
+    tile_len = np.minimum(np.repeat(ends, tiles_per_block) - tile_start, TILE)
+    tile_len = np.maximum(tile_len, 0)
+
+    # edge destination (CSR row) per edge, then per tile slot
+    deg = row_ptr[1:] - row_ptr[:-1]
+    dst = np.repeat(np.arange(n, dtype=np.int64), deg)
+
+    slot = np.arange(TILE, dtype=np.int64)
+    eidx = tile_start[:, None] + slot[None, :]  # (T, TILE)
+    valid = slot[None, :] < tile_len[:, None]
+    eidx_safe = np.where(valid, eidx, 0)
+    offs = np.where(
+        valid, dst[eidx_safe] - tile_block[:, None].astype(np.int64) * ROWS, -1
+    ).astype(np.int32)
+    cols = np.where(valid, col_idx[eidx_safe], 0).astype(np.int32)
+
+    return StaircasePlan(
+        tile_block=jnp.asarray(tile_block),
+        first_visit=jnp.asarray(first_visit),
+        offs=jnp.asarray(offs.reshape(T * 8, 128)),
+        col_gather=jnp.asarray(cols.reshape(T * 8, 128)),
+        n=n,
+        n_tiles=T,
+        n_blocks=n_blocks,
+    )
+
+
+def pack_words(bitmap: jax.Array) -> jax.Array:
+    """(N, M<=32) bool -> (N,) int32, bit m = slot m."""
+    m = bitmap.shape[1]
+    if m > 32:
+        raise ValueError(f"msg_slots={m} exceeds the 32-bit packing width")
+    weights = (1 << jnp.arange(m, dtype=jnp.int32))[None, :]
+    return jnp.sum(bitmap.astype(jnp.int32) * weights, axis=1, dtype=jnp.int32)
+
+
+def unpack_words(words: jax.Array, m: int) -> jax.Array:
+    """(N,) int32 -> (N, m) bool."""
+    return ((words[:, None] >> jnp.arange(m, dtype=jnp.int32)[None, :]) & 1).astype(bool)
+
+
+def _kernel(m: int):
+    def kernel(tb_ref, fv_ref, offs_ref, vals_ref, out_ref):
+        t = pl.program_id(0)
+        offs = offs_ref[:].reshape(1, TILE)  # (1, 1024)
+        words = vals_ref[:].reshape(1, TILE)
+        bits = jnp.concatenate(
+            [(words >> s) & 1 for s in range(m)], axis=0
+        ).astype(jnp.float32)  # (m, 1024)
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (ROWS, TILE), 0) == offs
+        ).astype(jnp.float32)  # (128, 1024); offs=-1 matches nothing
+        acc = jax.lax.dot_general(
+            bits, onehot, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (m, 128)
+
+        @pl.when(fv_ref[t] == 1)
+        def _():
+            out_ref[0] = acc
+
+        @pl.when(fv_ref[t] == 0)
+        def _():
+            out_ref[0] = out_ref[0] + acc
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+def segment_or(
+    plan: StaircasePlan, transmit: jax.Array, m: int, *, interpret: bool | None = None
+) -> jax.Array:
+    """incoming[i] = OR over CSR neighbors j of transmit[j] — flood delivery.
+
+    ``transmit``: (N, m) bool. One XLA gather (packed words along the edge
+    tiles) + one Pallas launch. Bit-exact vs ``kernels.gossip.flood_all``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    words = pack_words(transmit)
+    vals = words[plan.col_gather]  # (T*8, 128) int32
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(plan.n_tiles,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0)),
+            pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, m, ROWS), lambda t, tb, fv: (tb[t], 0, 0)),
+    )
+    out = pl.pallas_call(
+        _kernel(m),
+        out_shape=jax.ShapeDtypeStruct((plan.n_blocks, m, ROWS), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(plan.tile_block, plan.first_visit, plan.offs, vals)
+    # (NB, m, ROWS) -> (NB*ROWS, m) rows-major, trim padding rows
+    inc = out.transpose(0, 2, 1).reshape(plan.n_blocks * ROWS, m)
+    return inc[: plan.n] > 0.5

@@ -103,8 +103,13 @@ def _disseminate_local(
     receptive: jax.Array,
     k_push: jax.Array,
     k_pull: jax.Array,
+    plan=None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Single-shard dissemination; returns (incoming, msgs_sent)."""
+    """Single-shard dissemination; returns (incoming, msgs_sent).
+
+    ``plan`` (a :class:`~tpu_gossip.kernels.pallas_segment.StaircasePlan`)
+    routes flood delivery through the Pallas staircase kernel instead of
+    the XLA segment reduction (~2x at 1M peers on TPU; bit-exact)."""
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     incoming = jnp.zeros_like(state.seen)
     if cfg.mode in ("push", "push_pull"):
@@ -131,7 +136,12 @@ def _disseminate_local(
             answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pull_ok[:, 0]
         )
     if cfg.mode == "flood":
-        incoming = incoming | flood_all(transmit, state.row_ptr, state.col_idx)
+        if plan is not None:
+            from tpu_gossip.kernels.pallas_segment import segment_or
+
+            incoming = incoming | segment_or(plan, transmit, cfg.msg_slots)
+        else:
+            incoming = incoming | flood_all(transmit, state.row_ptr, state.col_idx)
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
         msgs_sent = msgs_sent + jnp.sum(transmit.sum(-1, dtype=jnp.int32) * deg)
     return incoming, msgs_sent
@@ -225,7 +235,7 @@ def advance_round(
 
 
 def gossip_round(
-    state: SwarmState, cfg: SwarmConfig
+    state: SwarmState, cfg: SwarmConfig, plan=None
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static."""
     rnd = state.round + 1
@@ -233,7 +243,7 @@ def gossip_round(
     _, transmitter, receptive = compute_roles(state)
     transmit = transmit_bitmap(state, cfg, transmitter)
     incoming, msgs_sent = _disseminate_local(
-        state, cfg, transmit, transmitter, receptive, k_push, k_pull
+        state, cfg, transmit, transmitter, receptive, k_push, k_pull, plan
     )
     return advance_round(
         state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join, receptive
@@ -242,13 +252,13 @@ def gossip_round(
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
 def simulate(
-    state: SwarmState, cfg: SwarmConfig, num_rounds: int
+    state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve."""
 
     def body(carry, _):
-        nxt, stats = gossip_round(carry, cfg)
+        nxt, stats = gossip_round(carry, cfg, plan)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -261,6 +271,7 @@ def run_until_coverage(
     target: float = 0.99,
     max_rounds: int = 1000,
     slot: int = 0,
+    plan=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -272,7 +283,7 @@ def run_until_coverage(
         return (s.coverage(slot) < target) & (s.round - state.round < max_rounds)
 
     def body(s: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round(s, cfg)
+        nxt, _ = gossip_round(s, cfg, plan)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

@@ -67,6 +67,7 @@ def bench_swarm(
     *,
     warmup: bool = True,
     reps: int = 1,
+    plan=None,
 ) -> tuple[BenchResult, SwarmState]:
     """Time the run-to-coverage while_loop on device (compile excluded).
 
@@ -76,12 +77,12 @@ def bench_swarm(
     measured.
     """
     if warmup:
-        float(run_until_coverage(state, cfg, target, max_rounds).coverage(0))
+        float(run_until_coverage(state, cfg, target, max_rounds, plan=plan).coverage(0))
     best = None
     fin = state
     for _ in range(max(reps, 1)):
         t0 = time.perf_counter()
-        fin = run_until_coverage(state, cfg, target, max_rounds)
+        fin = run_until_coverage(state, cfg, target, max_rounds, plan=plan)
         # host-fetch a scalar inside the timed region: on some platforms
         # (axon tunnel) block_until_ready returns before execution
         # completes, so the fetch is the only reliable completion barrier
