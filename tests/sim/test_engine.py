@@ -121,10 +121,52 @@ def test_sir_recovery_halts_transmission(graph):
     # 1-round infectious period with fanout 1 on a sparse graph: epidemic
     # dies out well below full coverage
     assert float(stats.coverage[-1]) < 0.9
-    rec = np.asarray(fin.recovered)
-    seen = np.asarray(fin.seen).any(-1)
+    rec = np.asarray(fin.recovered)  # (N, M): per-slot removal
+    seen = np.asarray(fin.seen)
     assert rec.sum() > 0
-    assert np.all(seen[rec])  # only infected peers recover
+    assert np.all(seen[rec])  # only infected slots recover
+
+
+def test_sir_recovery_is_per_slot(graph):
+    """A peer removed from one rumor must still receive and relay others
+    (the round-1 bug: global `recovered` made the first recovery block ALL
+    slots forever)."""
+    import dataclasses
+
+    cfg, st = make(graph, sir_recover_rounds=4, mode="push_pull", fanout=3)
+    fin, stats = simulate(st, cfg, 30)
+    # everyone who saw slot 0 has recovered from it by now
+    assert np.asarray(fin.recovered)[:, 0].sum() > 0.9 * N
+    # inject a SECOND rumor (slot 1) after the first epidemic is over
+    seen = fin.seen.at[7, 1].set(True)
+    infected = fin.infected_round.at[7, 1].set(fin.round)
+    st2 = dataclasses.replace(fin, seen=seen, infected_round=infected)
+    fin2, _ = simulate(st2, cfg, 30)
+    cov1 = np.asarray(fin2.seen)[:, 1].mean()
+    assert cov1 > 0.9, f"slot-1 epidemic stalled at {cov1} — recovery leaked across slots"
+
+
+def test_rewired_peers_attach_degree_preferentially(graph):
+    """BASELINE config 5: rejoining peers draw fresh neighbors with
+    probability proportional to degree (endpoint-list sampling)."""
+    cfg, st = make(
+        graph, churn_leave_prob=0.08, churn_join_prob=0.4, rewire_slots=4,
+        mode="push_pull",
+    )
+    fin, _ = simulate(st, cfg, 60)
+    rewired = np.asarray(fin.rewired)
+    assert rewired.sum() > 30, "not enough rejoin events to test"
+    targets = np.asarray(fin.rewire_targets)[rewired].ravel()
+    deg = np.asarray(fin.row_ptr[1:] - fin.row_ptr[:-1])
+    # endpoint sampling is size-biased: E[deg(target)] = E[d^2]/E[d] > E[d]
+    expected = (deg.astype(float) ** 2).sum() / deg.sum()
+    got = deg[targets].mean()
+    assert got > 0.6 * expected, (got, expected)
+    assert got > 1.5 * deg.mean(), (got, deg.mean())
+    # rejoiners stay in the swarm: most rewired live peers are re-infected
+    alive_rw = rewired & np.asarray(fin.alive)
+    if alive_rw.sum() > 10:
+        assert np.asarray(fin.seen).any(-1)[alive_rw].mean() > 0.5
 
 
 def test_churn_join_resets_state(graph):

@@ -24,7 +24,9 @@ def test_init_swarm_shapes_and_origin():
     assert bool(st.seen[0, 2]) and bool(st.seen[3, 2])
     assert int(st.seen.sum()) == 2
     assert st.n_peers == 100
-    assert int(st.infected_round[0]) == 0 and int(st.infected_round[1]) == -1
+    # infected_round is per (peer, slot)
+    assert int(st.infected_round[0, 2]) == 0 and int(st.infected_round[0, 0]) == -1
+    assert int(st.infected_round[1, 2]) == -1
 
 
 def test_state_is_pytree():

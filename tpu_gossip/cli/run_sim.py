@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--silent-frac", type=float, default=0.0, help="fraction of peers made silent (fault injection)")
     p.add_argument("--churn-leave", type=float, default=0.0, help="per-round leave probability")
     p.add_argument("--churn-join", type=float, default=0.0, help="per-round rejoin probability")
+    p.add_argument(
+        "--rewire-slots", type=int, default=0,
+        help="rejoiners attach this many fresh degree-preferential edges (0 = reuse slot edges)",
+    )
     p.add_argument("--seed", type=int, default=0, help="RNG seed")
     p.add_argument(
         "--staircase",
@@ -81,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
         sir_recover_rounds=args.sir_recover,
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
+        rewire_slots=args.rewire_slots,
     )
     plan = None
     if args.staircase:

@@ -118,9 +118,9 @@ class SimCluster:
         i = self._id(addr)
         st.seen = st.seen.at[i, slot].set(True)
         # record first-infection round unless already infected (-1 = never;
-        # engine gates SIR recovery on infected_round >= 0)
-        if int(st.infected_round[i]) < 0:
-            st.infected_round = st.infected_round.at[i].set(int(st.round))
+        # engine gates SIR recovery on infected_round >= 0; per-slot)
+        if int(st.infected_round[i, slot]) < 0:
+            st.infected_round = st.infected_round.at[i, slot].set(int(st.round))
 
     def has_seen(self, addr: Addr, text: str) -> bool:
         st = self._require_state()

@@ -64,10 +64,11 @@ class SwarmConfig:
     detect_period_rounds: int = 2  # 10 s detector sweep (Peer.py:363)
     round_seconds: float = 5.0  # gossip tick (Peer.py:396-408)
     forward_once: bool = False  # True: relay a message only on first receipt
-    sir_recover_rounds: int = 0  # >0 enables SIR: recover this many rounds after infection
+    sir_recover_rounds: int = 0  # >0 enables SIR: recover this many rounds after infection (per slot)
     mode: str = "push"  # "push" | "push_pull" | "flood" (BASELINE configs 1-4)
     churn_leave_prob: float = 0.0  # per-round P(alive peer departs) — Poisson churn
     churn_join_prob: float = 0.0  # per-round P(vacant slot rejoins)
+    rewire_slots: int = 0  # >0: rejoiners attach this many fresh degree-preferential edges
 
     def __post_init__(self):
         if self.n_peers <= 0:
@@ -89,14 +90,19 @@ class SwarmState:
     # dissemination
     seen: jax.Array  # bool (N, M) — hash-slot dedup bitmap
     forwarded: jax.Array  # bool (N, M) — already relayed (forward-once mode)
-    infected_round: jax.Array  # int32 (N,) — round of first infection (SIR; -1 = never)
-    recovered: jax.Array  # bool (N,) — SIR removed state
+    infected_round: jax.Array  # int32 (N, M) — round slot was first received (-1 = never)
+    recovered: jax.Array  # bool (N, M) — SIR removed state, per slot (multi-rumor safe)
     # liveness
     exists: jax.Array  # bool (N,) — static: slot is a real peer (False: pad/sentinel)
     alive: jax.Array  # bool (N,) — crashed/departed = False
     silent: jax.Array  # bool (N,) — fault injection: no heartbeats / PING replies
     last_hb: jax.Array  # int32 (N,) — round of last emitted heartbeat
     declared_dead: jax.Array  # bool (N,) — failure-detector verdict (registry purge)
+    # churn re-wiring (BASELINE config 5): rejoiners re-attach with fresh
+    # degree-preferential edges instead of reusing the departed peer's
+    # (reference demonstrate_powerlaw.py:5-39 applied at rejoin time)
+    rewired: jax.Array  # bool (N,) — slot re-attached since graph build
+    rewire_targets: jax.Array  # int32 (N, S>=1) — fresh neighbors of rewired slots
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -152,7 +158,13 @@ def load_swarm(path) -> SwarmState:
                 kwargs[name] = jax.random.wrap_key_data(jnp.asarray(data[f"key_{i}"]))
             else:
                 kwargs[name] = jnp.asarray(data[f"arr_{i}"])
-        kwargs["exists"] = jnp.ones(kwargs["alive"].shape, dtype=bool)
+        n, m = kwargs["seen"].shape
+        kwargs["exists"] = jnp.ones((n,), dtype=bool)
+        # v1 SIR state was per-peer; broadcast to the per-slot layout
+        kwargs["infected_round"] = jnp.broadcast_to(kwargs["infected_round"][:, None], (n, m))
+        kwargs["recovered"] = jnp.broadcast_to(kwargs["recovered"][:, None], (n, m))
+        kwargs["rewired"] = jnp.zeros((n,), dtype=bool)
+        kwargs["rewire_targets"] = jnp.zeros((n, 1), dtype=jnp.int32)
     return SwarmState(**kwargs)
 
 
@@ -193,25 +205,28 @@ def init_swarm(
         key = jax.random.key(0)
     n, m = config.n_peers, config.msg_slots
     seen = jnp.zeros((n, m), dtype=bool)
-    infected_round = jnp.full((n,), -1, dtype=jnp.int32)
+    infected_round = jnp.full((n, m), -1, dtype=jnp.int32)
     if origins is not None:
         origins = jnp.asarray(origins)
         seen = seen.at[origins, origin_slot].set(True)
-        infected_round = infected_round.at[origins].set(0)
+        infected_round = infected_round.at[origins, origin_slot].set(0)
     if exists is None:
         exists = jnp.ones((n,), dtype=bool)
+    s = max(config.rewire_slots, 1)
     return SwarmState(
         row_ptr=jnp.asarray(graph.row_ptr, dtype=jnp.int32),
         col_idx=jnp.asarray(graph.col_idx, dtype=jnp.int32),
         seen=seen,
         forwarded=jnp.zeros((n, m), dtype=bool),
         infected_round=infected_round,
-        recovered=jnp.zeros((n,), dtype=bool),
+        recovered=jnp.zeros((n, m), dtype=bool),
         exists=exists,
         alive=exists,
         silent=jnp.zeros((n,), dtype=bool),
         last_hb=jnp.zeros((n,), dtype=jnp.int32),
         declared_dead=jnp.zeros((n,), dtype=bool),
+        rewired=jnp.zeros((n,), dtype=bool),
+        rewire_targets=jnp.zeros((n, s), dtype=jnp.int32),
         rng=key,
         round=jnp.asarray(0, dtype=jnp.int32),
     )

@@ -281,7 +281,7 @@ def gossip_round_dist(
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
     if cfg.mode == "push_pull":
-        answer = state.seen & transmitter[:, None]
+        answer = state.seen & transmitter
         inc, msgs = _exchange(
             answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
             "pull", cfg.fanout,
@@ -290,7 +290,7 @@ def gossip_round_dist(
         # delivered bits + one request per pulling peer, mirroring the local
         # engine's accounting (sim/engine.py _disseminate_local) so the two
         # paths report comparable msgs_sent
-        requests = jnp.sum((sg.deg > 0) & receptive, dtype=jnp.int32)
+        requests = jnp.sum((sg.deg > 0) & receptive.any(-1), dtype=jnp.int32)
         msgs_sent = msgs_sent + jnp.sum(msgs) + requests
     if cfg.mode == "flood":
         inc, msgs = _exchange(

@@ -72,16 +72,17 @@ def _stats(state: SwarmState, msgs_sent: jax.Array) -> RoundStats:
 def compute_roles(
     state: SwarmState,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """(active, transmitter, receptive) masks for this round.
+    """(active (N,), transmitter (N, M), receptive (N, M)) masks.
 
     Declared-dead peers have had their sockets closed on both sides
     (Peer.py:314-320), so they neither send nor receive; silent peers keep
     gossiping (silence only gates heartbeats/PING replies, Peer.py:367,202);
-    SIR-recovered peers stop transmitting but retain their seen set.
+    SIR recovery is PER SLOT: a peer removed from one rumor keeps relaying
+    and receiving the others (multi-rumor swarms stay correct).
     """
     active = state.alive & ~state.declared_dead
-    transmitter = active & ~state.recovered
-    receptive = active & ~state.recovered  # susceptible: SIR-removed can't reinfect
+    transmitter = active[:, None] & ~state.recovered
+    receptive = active[:, None] & ~state.recovered  # SIR-removed slots can't reinfect
     return active, transmitter, receptive
 
 
@@ -89,7 +90,7 @@ def transmit_bitmap(
     state: SwarmState, cfg: SwarmConfig, transmitter: jax.Array
 ) -> jax.Array:
     """Slots each peer offers to push this round (forward_once budgets apply)."""
-    transmit = state.seen & transmitter[:, None]
+    transmit = state.seen & transmitter
     if cfg.forward_once:
         transmit = transmit & ~state.forwarded
     return transmit
@@ -112,11 +113,15 @@ def _disseminate_local(
     the XLA segment reduction (~2x at 1M peers on TPU; bit-exact)."""
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     incoming = jnp.zeros_like(state.seen)
+    k_push, k_rw_push = jax.random.split(k_push)
+    k_pull, k_rw_pull = jax.random.split(k_pull)
     if cfg.mode in ("push", "push_pull"):
         tgt, valid = sample_fanout_targets(
             k_push, state.row_ptr, state.col_idx, cfg.fanout
         )
-        push_valid = valid & transmitter[:, None]
+        if cfg.rewire_slots > 0:
+            tgt, valid = _substitute_rewired(state, cfg, tgt, valid, k_rw_push)
+        push_valid = valid & transmit.any(-1)[:, None]
         incoming = incoming | push_fanout(transmit, tgt, push_valid)
         msgs_sent = msgs_sent + jnp.sum(
             transmit.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
@@ -125,10 +130,16 @@ def _disseminate_local(
         # anti-entropy pull half (BASELINE config 3): each live peer asks one
         # random neighbor for everything it has — the responder's full seen
         # set, NOT the forward_once-masked transmit bitmap (relay budgets
-        # limit pushing, never answering a pull).
-        answer = state.seen & transmitter[:, None]
+        # limit pushing, never answering a pull). Per-slot SIR: removed
+        # slots don't answer.
+        answer = state.seen & transmitter
         ptgt, pvalid = sample_fanout_targets(k_pull, state.row_ptr, state.col_idx, 1)
-        pull_ok = pvalid & receptive[:, None]
+        if cfg.rewire_slots > 0:
+            ptgt, pvalid = _substitute_rewired(state, cfg, ptgt, pvalid, k_rw_pull)
+            # CSR edges pointing AT a rewired slot are stale (the departed
+            # peer's connections); a rejoiner's own fresh edges stay valid
+            pvalid = pvalid & (state.rewired[:, None] | ~state.rewired[ptgt])
+        pull_ok = pvalid & receptive.any(-1)[:, None]
         pull_got = pull_fanout(answer, ptgt, pull_ok)
         incoming = incoming | pull_got
         # cost = one request per puller + the responder's shipped bitmap
@@ -145,6 +156,26 @@ def _disseminate_local(
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
         msgs_sent = msgs_sent + jnp.sum(transmit.sum(-1, dtype=jnp.int32) * deg)
     return incoming, msgs_sent
+
+
+def _substitute_rewired(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    tgt: jax.Array,
+    valid: jax.Array,
+    key: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Re-wired peers sample fan-out targets from their fresh
+    degree-preferential attachments instead of the departed occupant's CSR
+    row (BASELINE config 5; reference demonstrate_powerlaw.py:5-39)."""
+    k = tgt.shape[1]
+    soff = jax.random.randint(key, tgt.shape, 0, cfg.rewire_slots)
+    stgt = jnp.take_along_axis(state.rewire_targets[:, : cfg.rewire_slots], soff, axis=1)
+    rw = state.rewired[:, None]
+    return (
+        jnp.where(rw, stgt, tgt),
+        jnp.where(rw, jnp.ones((1, k), dtype=bool), valid),
+    )
 
 
 def advance_round(
@@ -164,16 +195,16 @@ def advance_round(
     Shared by the local round (:func:`gossip_round`) and the multi-chip
     round (dist/mesh.py) so the protocol state machine exists exactly once.
     """
-    incoming = incoming & receptive[:, None]
+    incoming = incoming & receptive
     seen = state.seen | incoming
     forwarded = (state.forwarded | transmit) if cfg.forward_once else state.forwarded
 
-    newly_infected = incoming.any(-1) & ~state.seen.any(-1)
+    newly_infected = incoming & ~state.seen  # (N, M)
     infected_round = jnp.where(
         newly_infected & (state.infected_round < 0), rnd, state.infected_round
     )
 
-    # --- SIR recovery (BASELINE config 4) ---------------------------------
+    # --- SIR recovery, per slot (BASELINE config 4) -----------------------
     recovered = state.recovered
     if cfg.sir_recover_rounds > 0:
         recovered = recovered | (
@@ -193,16 +224,18 @@ def advance_round(
     # --- Poisson churn (BASELINE config 5) --------------------------------
     alive = state.alive
     silent = state.silent
+    rewired = state.rewired
+    rewire_targets = state.rewire_targets
     if cfg.churn_leave_prob > 0.0:
         leave = alive & (jax.random.uniform(k_leave, alive.shape) < cfg.churn_leave_prob)
         alive = alive & ~leave
     if cfg.churn_join_prob > 0.0:
-        # vacant slots rejoin with fresh protocol state; their edges were
-        # preallocated at graph build (jit-friendly churn, SURVEY.md §7.4:
-        # fixed slots + alive masks instead of per-round CSR rebuilds).
-        # Pad/sentinel slots (exists=False) never rejoin — they are not
-        # peers, and resurrecting them would dilute the coverage
+        # vacant slots rejoin with fresh protocol state (jit-friendly churn,
+        # SURVEY.md §7.4: fixed slots + alive masks instead of per-round CSR
+        # rebuilds). Pad/sentinel slots (exists=False) never rejoin — they
+        # are not peers, and resurrecting them would dilute the coverage
         # denominator with uninfectable degree-0 slots.
+        k_join, k_rw = jax.random.split(k_join)
         join = (~alive) & state.exists & (
             jax.random.uniform(k_join, alive.shape) < cfg.churn_join_prob
         )
@@ -210,11 +243,23 @@ def advance_round(
         fresh = join
         seen = seen & ~fresh[:, None]
         forwarded = forwarded & ~fresh[:, None]
-        infected_round = jnp.where(fresh, -1, infected_round)
-        recovered = recovered & ~fresh
+        infected_round = jnp.where(fresh[:, None], -1, infected_round)
+        recovered = recovered & ~fresh[:, None]
         silent = silent & ~fresh
         last_hb = jnp.where(fresh, rnd, last_hb)
         declared_dead = declared_dead & ~fresh
+        if cfg.rewire_slots > 0:
+            # power-law re-wiring: the arriving peer attaches its fresh
+            # edges degree-preferentially. A uniform index into the CSR
+            # endpoint list IS degree-proportional sampling — the
+            # repeated-endpoints trick of the reference's intended selector
+            # (demonstrate_powerlaw.py:5-39).
+            n, s = rewire_targets.shape
+            draws = state.col_idx[
+                jax.random.randint(k_rw, (n, s), 0, state.col_idx.shape[0])
+            ]
+            rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
+            rewired = rewired | fresh
 
     new_state = SwarmState(
         row_ptr=state.row_ptr,
@@ -228,6 +273,8 @@ def advance_round(
         silent=silent,
         last_hb=last_hb,
         declared_dead=declared_dead,
+        rewired=rewired,
+        rewire_targets=rewire_targets,
         rng=key,
         round=rnd,
     )
