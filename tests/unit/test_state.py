@@ -69,26 +69,58 @@ def test_checkpoint_roundtrip(tmp_path):
     assert bool(jnp.array_equal(jax.random.key_data(st2.rng), jax.random.key_data(st.rng)))
 
 
-def test_legacy_v1_checkpoint_loads(tmp_path):
-    """Round-1 checkpoints used positional arr_i/key_i keys and predate the
-    `exists` field — they must still load, with exists defaulting to ones."""
-    from tpu_gossip.core.state import _V1_FIELDS, load_swarm
+def save_v1(st, path, *, per_peer_sir):
+    """Write `st` in the round-1 positional arr_i/key_i checkpoint layout.
 
-    g = small_graph(32)
-    st = init_swarm(g, SwarmConfig(n_peers=32), origins=[2])
+    ``per_peer_sir=True`` emulates a true early-round-1 checkpoint (SIR
+    fields stored per-peer (N,)); ``False`` the late-round-1 per-slot form.
+    """
+    from tpu_gossip.core.state import _V1_FIELDS
+
     arrays = {}
     for i, name in enumerate(_V1_FIELDS):
         leaf = getattr(st, name)
+        if per_peer_sir and name in ("infected_round", "recovered"):
+            leaf = leaf[:, 0]
         if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
             arrays[f"key_{i}"] = np.asarray(jax.random.key_data(leaf))
         else:
             arrays[f"arr_{i}"] = np.asarray(leaf)
-    np.savez(tmp_path / "v1.npz", **arrays)
+    np.savez(path, **arrays)
+
+
+def test_legacy_v1_checkpoint_loads(tmp_path):
+    """Round-1 checkpoints used positional arr_i/key_i keys and predate the
+    `exists` field — they must still load, with exists defaulting to ones."""
+    from tpu_gossip.core.state import load_swarm
+
+    g = small_graph(32)
+    st = init_swarm(g, SwarmConfig(n_peers=32), origins=[2])
+    save_v1(st, tmp_path / "v1.npz", per_peer_sir=True)
 
     st2 = load_swarm(tmp_path / "v1.npz")
     assert bool(jnp.array_equal(st2.seen, st.seen))
     assert bool(jnp.array_equal(st2.alive, st.alive))
     assert bool(st2.exists.all()) and st2.exists.shape == st.alive.shape
+    # per-peer (N,) fields come back broadcast to the (N, M) slot layout
+    assert st2.infected_round.shape == st.seen.shape
+    assert st2.recovered.shape == st.seen.shape
+    assert bool(jnp.array_equal(st2.infected_round[:, 0], st.infected_round[:, 0]))
+
+
+def test_legacy_v1_checkpoint_with_per_slot_sir_loads(tmp_path):
+    """Late round-1 checkpoints already stored (N, M) SIR fields under the
+    positional keys — the v1 branch must accept those shapes unchanged."""
+    from tpu_gossip.core.state import load_swarm
+
+    g = small_graph(32)
+    st = init_swarm(g, SwarmConfig(n_peers=32), origins=[2])
+    save_v1(st, tmp_path / "v1b.npz", per_peer_sir=False)
+
+    st2 = load_swarm(tmp_path / "v1b.npz")
+    assert bool(jnp.array_equal(st2.seen, st.seen))
+    assert bool(jnp.array_equal(st2.infected_round, st.infected_round))
+    assert bool(jnp.array_equal(st2.recovered, st.recovered))
 
 
 def test_config_validation():

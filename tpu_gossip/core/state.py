@@ -160,9 +160,17 @@ def load_swarm(path) -> SwarmState:
                 kwargs[name] = jnp.asarray(data[f"arr_{i}"])
         n, m = kwargs["seen"].shape
         kwargs["exists"] = jnp.ones((n,), dtype=bool)
-        # v1 SIR state was per-peer; broadcast to the per-slot layout
-        kwargs["infected_round"] = jnp.broadcast_to(kwargs["infected_round"][:, None], (n, m))
-        kwargs["recovered"] = jnp.broadcast_to(kwargs["recovered"][:, None], (n, m))
+        # v1 SIR state was per-peer (N,); lift to the per-slot (N, M) layout,
+        # but only onto slots the peer actually saw — otherwise a resumed SIR
+        # run would mark never-received slots infected/recovered and the peer
+        # could never receive future rumors in them. Late round-1 checkpoints
+        # already carry (N, M) — keep those unchanged.
+        if kwargs["infected_round"].ndim == 1:
+            kwargs["infected_round"] = jnp.where(
+                kwargs["seen"], kwargs["infected_round"][:, None], -1
+            ).astype(jnp.int32)
+        if kwargs["recovered"].ndim == 1:
+            kwargs["recovered"] = kwargs["seen"] & kwargs["recovered"][:, None]
         kwargs["rewired"] = jnp.zeros((n,), dtype=bool)
         kwargs["rewire_targets"] = jnp.zeros((n, 1), dtype=jnp.int32)
     return SwarmState(**kwargs)
