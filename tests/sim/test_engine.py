@@ -169,6 +169,77 @@ def test_rewired_peers_attach_degree_preferentially(graph):
         assert np.asarray(fin.seen).any(-1)[alive_rw].mean() > 0.5
 
 
+def test_stale_edges_blocked_symmetrically():
+    """A rejoined (rewired) slot's old CSR edges are the departed occupant's:
+    neither push nor pull may deliver along them; only the rejoiner's fresh
+    edges carry its traffic (ADVICE r2: push previously leaked)."""
+    import dataclasses
+
+    # path graph 0-1: peer 0's only CSR neighbor is 1 and vice versa
+    g = build_csr(2, np.array([[0, 1]]))
+    cfg = SwarmConfig(n_peers=2, msg_slots=4, fanout=1, mode="push", rewire_slots=1)
+    st = init_swarm(g, cfg, origins=[0])
+    # peer 1 rejoined and rewired; its fresh edge points back at 0, so its
+    # own traffic still flows, but 0's CSR edge at it is stale
+    rw = dataclasses.replace(
+        st,
+        rewired=st.rewired.at[1].set(True),
+        rewire_targets=st.rewire_targets.at[1, 0].set(0),
+    )
+    fin, _ = simulate(rw, cfg, 5)
+    assert not bool(fin.seen[1].any()), "stale CSR push delivered to a rewired slot"
+
+    # the rejoiner's OWN traffic still flows over its fresh edge
+    rw_origin1 = dataclasses.replace(
+        init_swarm(g, cfg, origins=[1]),
+        rewired=rw.rewired,
+        rewire_targets=rw.rewire_targets,
+    )
+    fin_fresh, _ = simulate(rw_origin1, cfg, 5)
+    assert bool(fin_fresh.seen[0, 0]), "fresh-edge push from a rewired peer lost"
+
+    # pull over a fresh edge delivers too (push_pull, rewired puller)
+    cfg_pp = dataclasses.replace(cfg, mode="push_pull")
+    fin_pull, _ = simulate(rw, cfg_pp, 5)
+    assert bool(fin_pull.seen[1, 0]), "fresh-edge pull by a rewired peer lost"
+
+    # sanity: with the rewire flag cleared the same topology infects peer 1
+    st2 = dataclasses.replace(rw, rewired=rw.rewired.at[1].set(False))
+    fin2, _ = simulate(st2, cfg, 5)
+    assert bool(fin2.seen[1, 0])
+
+
+def test_sentinel_rewire_draws_are_invalidated():
+    """Endpoint draws landing on padding edges (DeviceGraph sentinel row) must
+    not become fan-out targets (ADVICE r2)."""
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+
+    dg = device_powerlaw_graph(300, gamma=2.5, key=jax.random.key(3))
+    cfg = SwarmConfig(
+        n_peers=dg.n_pad, msg_slots=4, churn_leave_prob=0.1,
+        churn_join_prob=0.5, rewire_slots=4, mode="push_pull",
+    )
+    st = init_swarm(dg.as_padded_graph(), cfg, origins=[0], exists=dg.exists)
+    fin, _ = simulate(st, cfg, 40)
+    rewired = np.asarray(fin.rewired)
+    assert rewired.sum() > 10
+    targets = np.asarray(fin.rewire_targets)[rewired].ravel()
+    exists = np.asarray(fin.exists)
+    ok = (targets == -1) | ((targets >= 0) & exists[np.maximum(targets, 0)])
+    assert ok.all(), "a rewire target points at the sentinel/padding row"
+
+
+def test_narrow_rewire_targets_fails_loudly():
+    """Resuming with cfg.rewire_slots wider than the stored rewire_targets
+    must raise instead of silently clamping (ADVICE r2)."""
+    g = build_csr(8, preferential_attachment(8, m=2, use_native=False))
+    cfg_narrow = SwarmConfig(n_peers=8, msg_slots=4, rewire_slots=1)
+    st = init_swarm(g, cfg_narrow, origins=[0])  # rewire_targets width 1
+    cfg_wide = SwarmConfig(n_peers=8, msg_slots=4, rewire_slots=3)
+    with pytest.raises(ValueError, match="rewire_slots"):
+        gossip_round(st, cfg_wide)
+
+
 def test_churn_join_resets_state(graph):
     cfg, st = make(graph, churn_leave_prob=0.05, churn_join_prob=0.2)
     fin, stats = simulate(st, cfg, 20)

@@ -44,6 +44,7 @@ from tpu_gossip.sim.engine import (
     advance_round,
     compute_roles,
     transmit_bitmap,
+    validate_rewire_width,
 )
 
 __all__ = [
@@ -266,6 +267,7 @@ def gossip_round_dist(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
             f"{mesh.size} devices — repartition with partition_graph(g, {mesh.size})"
         )
+    validate_rewire_width(state, cfg)
     rnd = state.round + 1
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = compute_roles(state)

@@ -40,6 +40,7 @@ __all__ = [
     "RoundStats",
     "compute_roles",
     "transmit_bitmap",
+    "validate_rewire_width",
     "advance_round",
     "gossip_round",
     "simulate",
@@ -121,6 +122,11 @@ def _disseminate_local(
         )
         if cfg.rewire_slots > 0:
             tgt, valid = _substitute_rewired(state, cfg, tgt, valid, k_rw_push)
+            # stale-edge filter, symmetric with the pull half below: a CSR
+            # edge pointing AT a rewired slot belongs to the departed
+            # occupant, so only fresh-edge traffic (rewired sender) reaches a
+            # rejoiner
+            valid = valid & (state.rewired[:, None] | ~state.rewired[tgt])
         push_valid = valid & transmit.any(-1)[:, None]
         incoming = incoming | push_fanout(transmit, tgt, push_valid)
         msgs_sent = msgs_sent + jnp.sum(
@@ -167,15 +173,30 @@ def _substitute_rewired(
 ) -> tuple[jax.Array, jax.Array]:
     """Re-wired peers sample fan-out targets from their fresh
     degree-preferential attachments instead of the departed occupant's CSR
-    row (BASELINE config 5; reference demonstrate_powerlaw.py:5-39)."""
-    k = tgt.shape[1]
+    row (BASELINE config 5; reference demonstrate_powerlaw.py:5-39).
+
+    Fresh targets of -1 are sentinel draws (the endpoint sample landed on a
+    padding edge) and stay invalid."""
     soff = jax.random.randint(key, tgt.shape, 0, cfg.rewire_slots)
     stgt = jnp.take_along_axis(state.rewire_targets[:, : cfg.rewire_slots], soff, axis=1)
     rw = state.rewired[:, None]
     return (
-        jnp.where(rw, stgt, tgt),
-        jnp.where(rw, jnp.ones((1, k), dtype=bool), valid),
+        jnp.where(rw, jnp.maximum(stgt, 0), tgt),
+        jnp.where(rw, stgt >= 0, valid),
     )
+
+
+def validate_rewire_width(state: SwarmState, cfg: SwarmConfig) -> None:
+    """Fail loudly when a checkpoint's rewire_targets is narrower than
+    ``cfg.rewire_slots`` — otherwise take_along_axis clamps the slot index
+    and rewired peers silently resample only the last stored target."""
+    if cfg.rewire_slots > state.rewire_targets.shape[1]:
+        raise ValueError(
+            f"cfg.rewire_slots={cfg.rewire_slots} exceeds the state's "
+            f"rewire_targets width {state.rewire_targets.shape[1]} — the "
+            "checkpoint was saved with fewer slots; pad rewire_targets or "
+            "lower rewire_slots"
+        )
 
 
 def advance_round(
@@ -258,6 +279,11 @@ def advance_round(
             draws = state.col_idx[
                 jax.random.randint(k_rw, (n, s), 0, state.col_idx.shape[0])
             ]
+            # a draw can land on a padding/sentinel edge slot (DeviceGraph
+            # CSRs point erased edges at the sentinel row) — mark those -1 so
+            # fan-out substitution treats them as invalid instead of pushing
+            # to a non-peer
+            draws = jnp.where(state.exists[draws], draws, -1)
             rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
             rewired = rewired | fresh
 
@@ -285,6 +311,7 @@ def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static."""
+    validate_rewire_width(state, cfg)
     rnd = state.round + 1
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = compute_roles(state)
