@@ -3,7 +3,8 @@
 The reference's CLI is two interactive scripts prompting for a port on stdin
 (reference Seed.py:479-492, Peer.py:456-465). Here: `run_sim` drives the
 batched tpu-sim transport; `run_seed`/`run_peer` run socket-compatible
-nodes (compat layer) with proper argparse flags instead of prompts.
+nodes (compat layer) with argparse flags — and, like the reference, fall
+back to a stdin port prompt when ``--port`` is omitted.
 """
 
 from __future__ import annotations
@@ -11,6 +12,27 @@ from __future__ import annotations
 import asyncio
 import sys
 import threading
+
+
+def prompt_port(role: str) -> int:
+    """Reference-parity stdin port prompt (Peer.py:456-465, Seed.py:479-492):
+    a bare ``run_peer``/``run_seed`` invocation asks for the port
+    interactively instead of erroring on a missing flag."""
+    while True:
+        try:
+            raw = input(f"Enter the port for this {role} node: ")
+        except EOFError:
+            print(f"no --port given and stdin closed; cannot start {role}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        try:
+            port = int(raw.strip())
+        except ValueError:
+            print(f"not a port number: {raw!r}", file=sys.stderr)
+            continue
+        if 0 < port < 65536:
+            return port
+        print(f"port out of range: {port}", file=sys.stderr)
 
 
 def stdin_queue(loop: asyncio.AbstractEventLoop) -> asyncio.Queue:

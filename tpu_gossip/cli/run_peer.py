@@ -15,7 +15,9 @@ import sys
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--ip", default="127.0.0.1")
-    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--port", type=int, default=None,
+                   help="listening port (omitted: prompt on stdin, like the "
+                   "reference Peer.py:456-465)")
     p.add_argument("--config", default="config.txt")
     p.add_argument("--no-relay", action="store_true",
                    help="reference-conformant one-hop gossip (no epidemic relay)")
@@ -70,7 +72,12 @@ async def amain(args) -> int:
 
 
 def main(argv=None) -> int:
-    return asyncio.run(amain(build_parser().parse_args(argv)))
+    args = build_parser().parse_args(argv)
+    if args.port is None:
+        from tpu_gossip.cli import prompt_port
+
+        args.port = prompt_port("peer")
+    return asyncio.run(amain(args))
 
 
 if __name__ == "__main__":

@@ -1,6 +1,7 @@
 """Seed node CLI (reference: ``python Seed.py`` + stdin port prompt,
-Seed.py:479-492). Proper flags replace the prompt; the operator command
-surface (``exit`` on stdin, periodic topology dumps) is preserved.
+Seed.py:479-492). Flags configure the node; a bare invocation falls back to
+the reference's stdin port prompt, and the operator command surface
+(``exit`` on stdin, periodic topology dumps) is preserved.
 """
 
 from __future__ import annotations
@@ -13,7 +14,9 @@ import sys
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--ip", default="127.0.0.1")
-    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--port", type=int, default=None,
+                   help="listening port (omitted: prompt on stdin, like the "
+                   "reference Seed.py:479-492)")
     p.add_argument("--config", default="config.txt")
     p.add_argument("--subset-policy", choices=["powerlaw", "first"], default="powerlaw")
     p.add_argument("--subset-size", type=int, default=3)
@@ -71,7 +74,12 @@ async def amain(args) -> int:
 
 
 def main(argv=None) -> int:
-    return asyncio.run(amain(build_parser().parse_args(argv)))
+    args = build_parser().parse_args(argv)
+    if args.port is None:
+        from tpu_gossip.cli import prompt_port
+
+        args.port = prompt_port("seed")
+    return asyncio.run(amain(args))
 
 
 if __name__ == "__main__":

@@ -51,6 +51,28 @@ def load_config(path: str) -> list[Addr]:
     return out
 
 
+class ConfigCache:
+    """``load_config`` memoized on (mtime_ns, size): registration-path reads
+    (``is_my_turn`` runs once per registering peer) cost a stat, not a parse
+    — the file only changes when a seed self-registers."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._stamp: tuple[int, int] | None = None
+        self._entries: list[Addr] = []
+
+    def entries(self) -> list[Addr]:
+        try:
+            st = os.stat(self.path)
+            stamp = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            stamp = None
+        if stamp != self._stamp:
+            self._entries = load_config(self.path)
+            self._stamp = stamp
+        return self._entries
+
+
 class SeedNode:
     """Registry node. ``transport="socket"`` only — in tpu-sim mode the seed
     role (bootstrap + topology) is played by :class:`compat.simnet.SimCluster`
@@ -79,6 +101,7 @@ class SeedNode:
             raise ValueError(f"unknown subset_policy {subset_policy!r}")
         self.addr: Addr = (ip, port)
         self.config_path = config_path
+        self._config_cache = ConfigCache(config_path)
         self.timing = timing or ProtocolTiming()
         self.subset_policy = subset_policy
         self.subset_size = subset_size
@@ -116,9 +139,10 @@ class SeedNode:
     # --- config bootstrap (Seed.py:89-125) ---------------------------------
 
     def load_and_register_config(self) -> None:
-        self.known_seeds = [a for a in load_config(self.config_path) if a != self.addr]
-        # self-registration: append own ip:port if absent (Seed.py:110-125)
-        entries = load_config(self.config_path)
+        entries = self._config_cache.entries()
+        self.known_seeds = [a for a in entries if a != self.addr]
+        # self-registration: append own ip:port if absent (Seed.py:110-125);
+        # the cache re-reads on next use (the append changes mtime/size)
         if self.addr not in entries:
             with open(self.config_path, "a") as f:
                 f.write(f"{self.addr[0]}:{self.addr[1]}\n")
@@ -160,7 +184,7 @@ class SeedNode:
         Seed.py:194-201). Peers contact the first ⌊n/2⌋+1 seeds in config
         file order (Peer.py:80-81), so the electorate is that deterministic
         prefix — electing a seed outside it would drop the handout."""
-        entries = load_config(self.config_path)
+        entries = self._config_cache.entries()
         quorum = entries[: len(entries) // 2 + 1]
         if self.addr not in quorum:
             return False
@@ -210,7 +234,7 @@ class SeedNode:
         """Retry lost seed-mesh links forever (Seed.py:336-341)."""
         while self.running:
             self.known_seeds = [
-                a for a in load_config(self.config_path) if a != self.addr
+                a for a in self._config_cache.entries() if a != self.addr
             ]
             for addr in self.known_seeds:
                 if addr in self.seed_writers:
