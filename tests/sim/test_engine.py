@@ -169,44 +169,60 @@ def test_rewired_peers_attach_degree_preferentially(graph):
         assert np.asarray(fin.seen).any(-1)[alive_rw].mean() > 0.5
 
 
-def test_stale_edges_blocked_symmetrically():
-    """A rejoined (rewired) slot's old CSR edges are the departed occupant's:
-    neither push nor pull may deliver along them; only the rejoiner's fresh
-    edges carry its traffic (ADVICE r2: push previously leaked)."""
+def test_stale_edges_blocked_fresh_edges_bidirectional():
+    """Re-wiring semantics: a rejoined slot's old CSR edges (the departed
+    occupant's) carry NOTHING either way; the rejoiner's fresh edges carry
+    traffic BOTH ways, like the TCP connections a socket rejoin opens
+    (ADVICE r2: push leaked over stale edges; the naive symmetric fix made
+    rejoiners unreachable in push mode)."""
     import dataclasses
 
-    # path graph 0-1: peer 0's only CSR neighbor is 1 and vice versa
-    g = build_csr(2, np.array([[0, 1]]))
-    cfg = SwarmConfig(n_peers=2, msg_slots=4, fanout=1, mode="push", rewire_slots=1)
+    # path 0-1, isolated 2: CSR neighbor of 0 is 1; rewired 1 attaches to 2
+    g = build_csr(3, np.array([[0, 1]]))
+    cfg = SwarmConfig(n_peers=3, msg_slots=4, fanout=1, mode="push", rewire_slots=1)
     st = init_swarm(g, cfg, origins=[0])
-    # peer 1 rejoined and rewired; its fresh edge points back at 0, so its
-    # own traffic still flows, but 0's CSR edge at it is stale
     rw = dataclasses.replace(
         st,
+        seen=st.seen.at[2, 1].set(True),  # second rumor at the fresh target
         rewired=st.rewired.at[1].set(True),
-        rewire_targets=st.rewire_targets.at[1, 0].set(0),
+        rewire_targets=st.rewire_targets.at[1, 0].set(2),
     )
     fin, _ = simulate(rw, cfg, 5)
-    assert not bool(fin.seen[1].any()), "stale CSR push delivered to a rewired slot"
+    seen = np.asarray(fin.seen)
+    # stale CSR edge 0->1 delivers nothing (slot 0 never reaches 1 or 2)
+    assert not seen[1, 0] and not seen[2, 0], "stale CSR push leaked"
+    # reverse-fresh: target 2's rumor reaches the rejoiner over 1's edge
+    assert seen[1, 1], "reverse-fresh push lost — rejoiner unreachable"
 
-    # the rejoiner's OWN traffic still flows over its fresh edge
-    rw_origin1 = dataclasses.replace(
-        init_swarm(g, cfg, origins=[1]),
-        rewired=rw.rewired,
-        rewire_targets=rw.rewire_targets,
-    )
+    # the rejoiner's OWN traffic flows outward over its fresh edge
+    rw_origin1 = dataclasses.replace(rw, seen=st.seen.at[1, 2].set(True))
     fin_fresh, _ = simulate(rw_origin1, cfg, 5)
-    assert bool(fin_fresh.seen[0, 0]), "fresh-edge push from a rewired peer lost"
+    assert bool(fin_fresh.seen[2, 2]), "fresh-edge push from a rewired peer lost"
 
     # pull over a fresh edge delivers too (push_pull, rewired puller)
     cfg_pp = dataclasses.replace(cfg, mode="push_pull")
     fin_pull, _ = simulate(rw, cfg_pp, 5)
-    assert bool(fin_pull.seen[1, 0]), "fresh-edge pull by a rewired peer lost"
+    assert bool(fin_pull.seen[1, 1]), "fresh-edge pull by a rewired peer lost"
 
-    # sanity: with the rewire flag cleared the same topology infects peer 1
+    # sanity: with the rewire flag cleared the CSR edge infects peer 1 again
     st2 = dataclasses.replace(rw, rewired=rw.rewired.at[1].set(False))
     fin2, _ = simulate(st2, cfg, 5)
     assert bool(fin2.seen[1, 0])
+
+
+def test_heavy_churn_swarm_sustains_coverage():
+    """Under sustained churn + re-wiring most slots eventually hold
+    rejoiners; bidirectional fresh edges must keep the swarm connected
+    (directional fresh edges collapsed push coverage to ~0.2)."""
+    g = build_csr(2000, preferential_attachment(2000, m=3, use_native=False,
+                                                rng=np.random.default_rng(31)))
+    cfg = SwarmConfig(
+        n_peers=2000, msg_slots=4, fanout=3, mode="push",
+        churn_leave_prob=0.05, churn_join_prob=0.3, rewire_slots=4,
+    )
+    st = init_swarm(g, cfg, origins=list(range(5)), key=jax.random.key(9))
+    _, stats = simulate(st, cfg, 40)
+    assert float(stats.coverage[-1]) > 0.7, float(stats.coverage[-1])
 
 
 def test_sentinel_rewire_draws_are_invalidated():

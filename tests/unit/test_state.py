@@ -131,3 +131,23 @@ def test_config_validation():
     g = small_graph(50)
     with pytest.raises(ValueError):
         init_swarm(g, SwarmConfig(n_peers=49))
+
+
+def test_init_swarm_origin_slots_multi_rumor():
+    """origin_slots seeds one rumor per slot (the M>1 bench shape)."""
+    import jax
+    import numpy as np
+    import pytest
+
+    from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+
+    g = build_csr(64, preferential_attachment(64, m=2, use_native=False))
+    cfg = SwarmConfig(n_peers=64, msg_slots=8)
+    st = init_swarm(g, cfg, origins=list(range(8)), origin_slots=list(range(8)))
+    seen = np.asarray(st.seen)
+    assert seen.sum() == 8
+    assert all(seen[i, i] for i in range(8))
+    with pytest.raises(ValueError, match="origin_slots"):
+        init_swarm(g, cfg, origins=[0, 1], origin_slots=[0])
+    with pytest.raises(ValueError, match="origin_slots"):
+        init_swarm(g, cfg, origins=[0], origin_slots=[8])

@@ -51,7 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--staircase",
         action="store_true",
-        help="flood delivery via the Pallas staircase kernel (mode=flood only)",
+        help="deliver via the Pallas staircase kernel: exact segment-OR for "
+        "flood, Bernoulli-per-edge sampling for push/push_pull (needs "
+        "--rewire-slots 0 and --slots <= 32)",
     )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
@@ -89,16 +91,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     plan = None
     if args.staircase:
-        if args.mode != "flood":
-            print("--staircase requires --mode flood", file=sys.stderr)
-            return 2
         if args.slots > 32:
             print("--staircase packs slots into one int32 word: --slots must be <= 32",
                   file=sys.stderr)
             return 2
+        if args.rewire_slots > 0 and args.mode != "flood":
+            print("--staircase sampling uses static edge tables: not compatible "
+                  "with --rewire-slots (churn re-wiring runs the XLA path)",
+                  file=sys.stderr)
+            return 2
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
-        plan = build_staircase_plan(graph.row_ptr, graph.col_idx)
+        plan = build_staircase_plan(
+            graph.row_ptr, graph.col_idx,
+            fanout=None if args.mode == "flood" else args.fanout,
+        )
 
     origins = rng.choice(args.peers, size=min(args.origins, args.peers), replace=False)
     state = init_swarm(graph, cfg, key=jax.random.key(args.seed), origins=origins)

@@ -198,11 +198,15 @@ def init_swarm(
     key: jax.Array | None = None,
     origins: np.ndarray | list[int] | None = None,
     origin_slot: int = 0,
+    origin_slots: np.ndarray | list[int] | None = None,
     exists: jax.Array | None = None,
 ) -> SwarmState:
     """Build device state from a graph; optionally infect ``origins`` in ``origin_slot``.
 
-    ``graph`` may hold host numpy or device arrays (e.g. a
+    ``origin_slots`` (same length as ``origins``) seeds each origin into its
+    own hash slot — a multi-rumor swarm where every slot carries traffic
+    (the realistic M>1 benchmark shape); default: all origins in
+    ``origin_slot``. ``graph`` may hold host numpy or device arrays (e.g. a
     ``DeviceGraph``-backed CSR) — per-peer state is constructed on device, so
     nothing peer-sized crosses the host link. ``exists`` marks real peer
     slots (default all); non-existent slots (pads/sentinels) start dead.
@@ -216,8 +220,23 @@ def init_swarm(
     infected_round = jnp.full((n, m), -1, dtype=jnp.int32)
     if origins is not None:
         origins = jnp.asarray(origins)
-        seen = seen.at[origins, origin_slot].set(True)
-        infected_round = infected_round.at[origins, origin_slot].set(0)
+        if origin_slots is not None:
+            slots_host = np.asarray(origin_slots)
+            if slots_host.shape != np.asarray(origins).shape:
+                raise ValueError(
+                    f"origin_slots shape {slots_host.shape} != origins shape"
+                    f" {np.asarray(origins).shape}"
+                )
+            if slots_host.size and (slots_host.min() < 0 or slots_host.max() >= m):
+                raise ValueError(
+                    f"origin_slots must lie in [0, msg_slots={m}); got "
+                    f"[{slots_host.min()}, {slots_host.max()}]"
+                )
+            slots = jnp.asarray(slots_host)
+        else:
+            slots = jnp.full(origins.shape, origin_slot)
+        seen = seen.at[origins, slots].set(True)
+        infected_round = infected_round.at[origins, slots].set(0)
     if exists is None:
         exists = jnp.ones((n,), dtype=bool)
     s = max(config.rewire_slots, 1)

@@ -39,10 +39,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm
 from tpu_gossip.core.topology import Graph, build_csr
+from tpu_gossip.kernels.gossip import pull_fanout, push_fanout
 from tpu_gossip.sim.engine import (
     RoundStats,
     advance_round,
     compute_roles,
+    reverse_fresh_push,
     transmit_bitmap,
     validate_rewire_width,
 )
@@ -210,23 +212,31 @@ def _exchange(
     mesh: Mesh,
     activation: str,  # "push" | "pull" | "flood"
     fanout: int,
+    blocked_rows: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One bucketed all_to_all fan-out; returns (incoming, msgs_per_shard).
 
     ``transmit`` (n_pad, M) is peer-sharded; ``keys`` is an (S,) key array
     (one per shard). ``msgs_per_shard`` is (S,) slot-sends per shard.
+    ``blocked_rows`` (n_pad,) bool marks receivers whose static CSR in-edges
+    are stale (rewired slots): their deliveries are dropped AND excluded
+    from the message count on the receiving shard — so msgs matches the
+    local engine, which filters stale edges before counting.
     """
     s, b = sg.n_shards, sg.bucket
     per = sg.per_shard
     m = transmit.shape[1]
+    if blocked_rows is None:
+        blocked_rows = jnp.zeros(transmit.shape[0], dtype=bool)
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        in_specs=(P(AXIS),) * 8,
         out_specs=(P(AXIS), P(AXIS)),
     )
-    def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, deg_blk, key_blk):
+    def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, deg_blk, key_blk,
+           blocked_blk):
         send_src, recv_dst = send_src[0], recv_dst[0]  # (S, B)
         valid, dst_deg = valid[0], dst_deg[0]
         vals = transmit_blk[send_src]  # (S, B, M)
@@ -241,10 +251,13 @@ def _exchange(
             p = 1.0 / jnp.maximum(dst_deg, 1)
             active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
         payload = vals & active[:, :, None]  # (S, B, M)
-        msgs = jnp.sum(payload, dtype=jnp.int32)
         received = jax.lax.all_to_all(
             payload, AXIS, split_axis=0, concat_axis=0, tiled=True
         )  # received[s'] = bucket shard s' packed for me
+        # receiver-side stale filter BEFORE counting (stale deliveries are
+        # neither delivered nor billed, like the local engine's edge masks)
+        received = received & ~blocked_blk[recv_dst][:, :, None]
+        msgs = jnp.sum(received, dtype=jnp.int32)
         incoming = (
             jnp.zeros((per, m), dtype=bool)
             .at[recv_dst.reshape(-1)]
@@ -254,14 +267,77 @@ def _exchange(
 
     return ex(
         transmit, sg.send_src, sg.recv_dst, sg.send_valid, sg.send_dst_deg,
-        sg.deg, keys,
+        sg.deg, keys, blocked_rows,
     )
+
+
+def _fresh_rewire_traffic(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    transmit: jax.Array,
+    answer: jax.Array,
+    receptive_any: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+    do_pull: bool,
+) -> tuple[jax.Array, jax.Array]:
+    """Dissemination over rejoined peers' fresh degree-preferential edges.
+
+    The bucket tables are static per graph, so a rejoiner's fresh edges
+    can't ride the all_to_all; they go through GLOBAL-VIEW gather/scatter
+    instead — outside shard_map, so XLA's SPMD partitioner inserts the
+    collectives. Rewire traffic is sparse (only rejoined slots fire), and
+    the semantics mirror the local engine's ``_substitute_rewired`` exactly:
+    push fans out to ``fanout`` draws from the fresh targets, pull asks one,
+    and the bidirectional reverse pass delivers the targets' pushes back to
+    the rejoiner (sim.engine.reverse_fresh_push). Fresh-target -1 entries
+    (sentinel draws) stay invalid.
+    """
+    incoming = jnp.zeros_like(transmit)
+    msgs = jnp.zeros((), dtype=jnp.int32)
+    n = state.rewired.shape[0]
+    k_push, k_rev = jax.random.split(k_push)
+
+    def draw(key, width):
+        soff = jax.random.randint(key, (n, width), 0, cfg.rewire_slots)
+        stgt = jnp.take_along_axis(
+            state.rewire_targets[:, : cfg.rewire_slots], soff, axis=1
+        )
+        return jnp.maximum(stgt, 0), state.rewired[:, None] & (stgt >= 0)
+
+    tgt, valid = draw(k_push, cfg.fanout)
+    push_valid = valid & transmit.any(-1)[:, None]
+    incoming = incoming | push_fanout(transmit, tgt, push_valid)
+    msgs = msgs + jnp.sum(
+        transmit.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
+    )
+    rev, rev_msgs = reverse_fresh_push(state, cfg, transmit, k_rev)
+    incoming = incoming | rev
+    msgs = msgs + rev_msgs
+    if do_pull:
+        ptgt, pvalid = draw(k_pull, 1)
+        # a dead / fully-removed rewired slot asks nobody (the local
+        # engine's pull_ok gate)
+        pvalid = pvalid & receptive_any[:, None]
+        incoming = incoming | pull_fanout(answer, ptgt, pvalid)
+        msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
+            answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pvalid[:, 0]
+        )
+    return incoming, msgs
 
 
 def gossip_round_dist(
     state: SwarmState, cfg: SwarmConfig, sg: ShardedGraph, mesh: Mesh
 ) -> tuple[SwarmState, RoundStats]:
-    """One multi-chip round: bucketed exchange + the shared protocol tail."""
+    """One multi-chip round: bucketed exchange + the shared protocol tail.
+
+    With churn re-wiring (``cfg.rewire_slots > 0``, push/push_pull), the
+    static bucket traffic is masked the way the local engine masks stale
+    edges — a rewired sender's CSR out-edges carry nothing, and nothing
+    arrives at a rewired slot over CSR edges — and the rejoiners' fresh
+    degree-preferential edges carry their traffic via
+    :func:`_fresh_rewire_traffic`. Flood mode ignores re-wiring (both
+    engines: the flood is defined over the static CSR)."""
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -270,30 +346,42 @@ def gossip_round_dist(
     validate_rewire_width(state, cfg)
     rnd = state.round + 1
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    k_push, k_rw_push = jax.random.split(k_push)
+    k_pull, k_rw_pull = jax.random.split(k_pull)
     _, transmitter, receptive = compute_roles(state)
     transmit = transmit_bitmap(state, cfg, transmitter)
+
+    rewiring = cfg.rewire_slots > 0 and cfg.mode in ("push", "push_pull")
+    # a rewired sender's static CSR out-edges are the departed occupant's:
+    # they carry nothing (its traffic rides its fresh edges below); its
+    # static in-edges drop deliveries receiver-side inside _exchange
+    static_tx = transmit & ~state.rewired[:, None] if rewiring else transmit
+    blocked = state.rewired if rewiring else None
+    answer = state.seen & transmitter
 
     incoming = jnp.zeros_like(state.seen)
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     if cfg.mode in ("push", "push_pull"):
         inc, msgs = _exchange(
-            transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
-            "push", cfg.fanout,
+            static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
+            "push", cfg.fanout, blocked_rows=blocked,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
     if cfg.mode == "push_pull":
-        answer = state.seen & transmitter
+        static_answer = answer & ~state.rewired[:, None] if rewiring else answer
         inc, msgs = _exchange(
-            answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
-            "pull", cfg.fanout,
+            static_answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
+            "pull", cfg.fanout, blocked_rows=blocked,
         )
         incoming = incoming | inc
         # delivered bits + one request per pulling peer, mirroring the local
-        # engine's accounting (sim/engine.py _disseminate_local) so the two
-        # paths report comparable msgs_sent
-        requests = jnp.sum((sg.deg > 0) & receptive.any(-1), dtype=jnp.int32)
-        msgs_sent = msgs_sent + jnp.sum(msgs) + requests
+        # engine's accounting (sim/engine.py _disseminate_local); rewired
+        # pullers are billed in _fresh_rewire_traffic instead, not twice
+        pulls = (sg.deg > 0) & receptive.any(-1)
+        if rewiring:
+            pulls = pulls & ~state.rewired
+        msgs_sent = msgs_sent + jnp.sum(msgs) + jnp.sum(pulls, dtype=jnp.int32)
     if cfg.mode == "flood":
         inc, msgs = _exchange(
             transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
@@ -301,6 +389,14 @@ def gossip_round_dist(
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
+
+    if rewiring:
+        inc, msgs = _fresh_rewire_traffic(
+            state, cfg, transmit, answer, receptive.any(-1), k_rw_push, k_rw_pull,
+            do_pull=(cfg.mode == "push_pull"),
+        )
+        incoming = incoming | inc
+        msgs_sent = msgs_sent + msgs
 
     return advance_round(
         state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join, receptive

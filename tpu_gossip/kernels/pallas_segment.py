@@ -21,6 +21,15 @@ serializes — so this module reformulates it for the MXU:
 
 ``segment_or`` == ``kernels.gossip.flood_all`` bit-for-bit (parity-tested);
 the engine uses it for flood-mode dissemination when a plan is supplied.
+
+``segment_sampled`` runs SAMPLED delivery (push / push-pull, the headline
+benchmark modes) through the same kernel: every edge slot carries a
+precomputed uint32 Bernoulli threshold — ``min(1, fanout/deg(sender))`` for
+push, ``1/deg(puller)`` for pull, the static-shape equivalence of exactly-k
+neighbor sampling that dist/mesh.py already uses for its bucketed exchange —
+and one uniform-bits draw masks the gathered words before the segment-OR.
+Push and pull words are OR-combined per edge, so a push_pull round is ONE
+kernel launch instead of XLA's serialized scatter + gather.
 """
 
 from __future__ import annotations
@@ -35,7 +44,14 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["StaircasePlan", "build_staircase_plan", "pack_words", "unpack_words", "segment_or"]
+__all__ = [
+    "StaircasePlan",
+    "build_staircase_plan",
+    "pack_words",
+    "unpack_words",
+    "segment_or",
+    "segment_sampled",
+]
 
 ROWS = 128  # output rows per block (out block last dim)
 TILE = 1024  # edges per tile, stored (8, 128)
@@ -44,7 +60,11 @@ TILE = 1024  # edges per tile, stored (8, 128)
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class StaircasePlan:
-    """Static routing tables for one graph (device arrays + static sizes)."""
+    """Static routing tables for one graph (device arrays + static sizes).
+
+    ``push_thresh``/``pull_thresh`` (present when the plan was built with a
+    ``fanout``) are per-edge-slot uint32 Bernoulli thresholds for sampled
+    delivery; pad slots hold 0 (never active)."""
 
     tile_block: jax.Array  # int32 (T,) — output block index per tile
     first_visit: jax.Array  # int32 (T,) — 1 iff first tile of its block
@@ -53,14 +73,28 @@ class StaircasePlan:
     n: int = dataclasses.field(metadata=dict(static=True))
     n_tiles: int = dataclasses.field(metadata=dict(static=True))
     n_blocks: int = dataclasses.field(metadata=dict(static=True))
+    push_thresh: jax.Array | None = None  # uint32 (T*8, 128) — P(edge fires) for push
+    pull_thresh: jax.Array | None = None  # uint32 (T*8, 128) — P(edge fires) for pull
+    fanout: int | None = dataclasses.field(default=None, metadata=dict(static=True))
 
 
-def build_staircase_plan(row_ptr: np.ndarray, col_idx: np.ndarray) -> StaircasePlan:
+def _bernoulli_threshold(p: np.ndarray) -> np.ndarray:
+    """P(u32 < thresh) == min(p, 1) up to 2^-32 (p=1 fires with probability
+    1 - 2^-32 — one silent miss per ~4e9 edge draws, immaterial)."""
+    return np.minimum(np.ceil(np.clip(p, 0.0, 1.0) * 2.0**32), 2.0**32 - 1).astype(
+        np.uint32
+    )
+
+
+def build_staircase_plan(
+    row_ptr: np.ndarray, col_idx: np.ndarray, fanout: int | None = None
+) -> StaircasePlan:
     """Cut the CSR's destination-grouped edges into MXU tiles (host, once).
 
     Every 128-row output block gets >= 1 tile (so the kernel zero-initializes
     every block), and no tile spans two blocks (so accumulation is pure
-    block revisiting).
+    block revisiting). With ``fanout``, also precompute the sampled-delivery
+    Bernoulli thresholds (enables :func:`segment_sampled`).
     """
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     col_idx = np.asarray(col_idx, dtype=np.int64)
@@ -93,10 +127,36 @@ def build_staircase_plan(row_ptr: np.ndarray, col_idx: np.ndarray) -> StaircaseP
     eidx = tile_start[:, None] + slot[None, :]  # (T, TILE)
     valid = slot[None, :] < tile_len[:, None]
     eidx_safe = np.where(valid, eidx, 0)
+    edge_dst = dst[eidx_safe]  # CSR row (receiver) per edge slot
     offs = np.where(
-        valid, dst[eidx_safe] - tile_block[:, None].astype(np.int64) * ROWS, -1
+        valid, edge_dst - tile_block[:, None].astype(np.int64) * ROWS, -1
     ).astype(np.int32)
     cols = np.where(valid, col_idx[eidx_safe], 0).astype(np.int32)
+
+    push_thresh = pull_thresh = None
+    if fanout is not None:
+        # push: sender j fires each of its deg(j) out-edges w.p. fanout/deg(j)
+        # (expected fanout pushes — the exactly-k twin with static shapes);
+        # pull: receiver i draws each of its deg(i) in-edges w.p. 1/deg(i)
+        # (expected one pull request). Same activation law as the bucketed
+        # dist exchange (dist/mesh.py _exchange).
+        edge_src_deg = np.where(valid, deg[col_idx[eidx_safe]], 0)
+        edge_dst_deg = np.where(valid, deg[edge_dst], 0)
+        with np.errstate(divide="ignore"):
+            push_thresh = jnp.asarray(
+                np.where(
+                    valid & (edge_src_deg > 0),
+                    _bernoulli_threshold(fanout / np.maximum(edge_src_deg, 1)),
+                    np.uint32(0),
+                ).reshape(T * 8, 128)
+            )
+            pull_thresh = jnp.asarray(
+                np.where(
+                    valid & (edge_dst_deg > 0),
+                    _bernoulli_threshold(1.0 / np.maximum(edge_dst_deg, 1)),
+                    np.uint32(0),
+                ).reshape(T * 8, 128)
+            )
 
     return StaircasePlan(
         tile_block=jnp.asarray(tile_block),
@@ -106,6 +166,9 @@ def build_staircase_plan(row_ptr: np.ndarray, col_idx: np.ndarray) -> StaircaseP
         n=n,
         n_tiles=T,
         n_blocks=n_blocks,
+        push_thresh=push_thresh,
+        pull_thresh=pull_thresh,
+        fanout=fanout,
     )
 
 
@@ -150,20 +213,13 @@ def _kernel(m: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("m", "interpret"))
-def segment_or(
-    plan: StaircasePlan, transmit: jax.Array, m: int, *, interpret: bool | None = None
+def _launch(
+    plan: StaircasePlan, vals: jax.Array, m: int, interpret: bool | None
 ) -> jax.Array:
-    """incoming[i] = OR over CSR neighbors j of transmit[j] — flood delivery.
-
-    ``transmit``: (N, m) bool. One XLA gather (packed words along the edge
-    tiles) + one Pallas launch. Bit-exact vs ``kernels.gossip.flood_all``.
-    """
+    """Run the staircase kernel over pre-gathered per-edge words
+    ``vals`` (T*8, 128) int32 → (N, m) bool segment-OR by destination row."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    words = pack_words(transmit)
-    vals = words[plan.col_gather]  # (T*8, 128) int32
-
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(plan.n_tiles,),
@@ -182,3 +238,85 @@ def segment_or(
     # (NB, m, ROWS) -> (NB*ROWS, m) rows-major, trim padding rows
     inc = out.transpose(0, 2, 1).reshape(plan.n_blocks * ROWS, m)
     return inc[: plan.n] > 0.5
+
+
+@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+def segment_or(
+    plan: StaircasePlan, transmit: jax.Array, m: int, *, interpret: bool | None = None
+) -> jax.Array:
+    """incoming[i] = OR over CSR neighbors j of transmit[j] — flood delivery.
+
+    ``transmit``: (N, m) bool. One XLA gather (packed words along the edge
+    tiles) + one Pallas launch. Bit-exact vs ``kernels.gossip.flood_all``.
+    """
+    vals = pack_words(transmit)[plan.col_gather]  # (T*8, 128) int32
+    return _launch(plan, vals, m, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "do_push", "do_pull", "interpret"))
+def segment_sampled(
+    plan: StaircasePlan,
+    transmit: jax.Array,
+    answer: jax.Array | None,
+    m: int,
+    key: jax.Array,
+    *,
+    receptive_rows: jax.Array | None = None,
+    do_push: bool = True,
+    do_pull: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sampled (push / push-pull) delivery as ONE staircase kernel launch.
+
+    Per-edge Bernoulli activation (thresholds precomputed in the plan; one
+    independent uint32 draw per direction per edge slot) masks the gathered
+    packed words; push and pull words are OR-combined so the MXU contraction
+    runs once. ``answer=None`` means the pull half answers with ``transmit``
+    (the usual non-forward_once case) and skips the second pack+gather.
+    ``receptive_rows`` (N,) bool gates the PULL half by the puller: a dead
+    or fully-removed peer asks nobody — matching the XLA path's
+    ``pull_ok`` gate. Returns ``(incoming (N, m) bool, msgs_sent scalar)``
+    where msgs counts delivered slot-bits per active edge plus one request
+    per active pull edge (the XLA path's accounting in expectation).
+
+    Sampling semantics are expected-``fanout`` Bernoulli per edge, not
+    exactly-``fanout`` — identical to the dist engine's bucketed exchange
+    (dist/mesh.py), and statistically indistinguishable on coverage curves
+    (tests/unit/test_pallas_segment.py bounds the discrepancy).
+    """
+    if plan.push_thresh is None:
+        raise ValueError("plan built without fanout — no sampling thresholds")
+    shape = plan.col_gather.shape
+    k_push, k_pull = jax.random.split(key)
+    w_push = pack_words(transmit)[plan.col_gather]
+    combined = jnp.zeros(shape, jnp.int32)
+    msgs = jnp.zeros((), jnp.int32)
+    if do_push:
+        active_p = jax.random.bits(k_push, shape, jnp.uint32) < plan.push_thresh
+        wp = jnp.where(active_p, w_push, 0)
+        combined = combined | wp
+        msgs = msgs + jnp.sum(jax.lax.population_count(wp), dtype=jnp.int32)
+    if do_pull:
+        w_ans = w_push if answer is None else pack_words(answer)[plan.col_gather]
+        active_q = jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_thresh
+        if receptive_rows is not None:
+            # per-edge puller mask via the plan's block structure: edge slot
+            # (tile t, local row offs) pulls for peer tile_block[t]*128+offs,
+            # so a (T, 128) row-gather indexed by offs suffices — no full
+            # random gather
+            t8, _ = shape
+            t = t8 // 8
+            pad = plan.n_blocks * ROWS - receptive_rows.shape[0]
+            rec = jnp.pad(receptive_rows, (0, pad)).reshape(plan.n_blocks, ROWS)
+            rec_tiles = rec[plan.tile_block]  # (T, 128)
+            rec_edge = jnp.take_along_axis(
+                rec_tiles, jnp.maximum(plan.offs.reshape(t, 8 * 128), 0), axis=1
+            ).reshape(shape)
+            active_q = active_q & rec_edge
+        wq = jnp.where(active_q, w_ans, 0)
+        combined = combined | wq
+        # one request per fired pull edge + the responder's shipped bits
+        msgs = msgs + jnp.sum(active_q, dtype=jnp.int32) + jnp.sum(
+            jax.lax.population_count(wq), dtype=jnp.int32
+        )
+    return _launch(plan, combined, m, interpret), msgs

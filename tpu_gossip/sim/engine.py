@@ -41,6 +41,7 @@ __all__ = [
     "compute_roles",
     "transmit_bitmap",
     "validate_rewire_width",
+    "reverse_fresh_push",
     "advance_round",
     "gossip_round",
     "simulate",
@@ -110,23 +111,53 @@ def _disseminate_local(
     """Single-shard dissemination; returns (incoming, msgs_sent).
 
     ``plan`` (a :class:`~tpu_gossip.kernels.pallas_segment.StaircasePlan`)
-    routes flood delivery through the Pallas staircase kernel instead of
-    the XLA segment reduction (~2x at 1M peers on TPU; bit-exact)."""
+    routes delivery through the Pallas staircase kernel instead of XLA's
+    scatter/segment reduction: flood always, push/push_pull when the plan
+    carries sampling thresholds (built with ``fanout``). Sampled-kernel
+    rounds use Bernoulli-per-edge activation (the dist engine's semantics)
+    rather than exactly-k; churn re-wiring keeps the XLA path (the kernel's
+    edge tables are static)."""
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     incoming = jnp.zeros_like(state.seen)
     k_push, k_rw_push = jax.random.split(k_push)
     k_pull, k_rw_pull = jax.random.split(k_pull)
+    sampled_kernel = (
+        plan is not None
+        and getattr(plan, "push_thresh", None) is not None
+        and cfg.mode in ("push", "push_pull")
+        and cfg.rewire_slots == 0
+    )
+    if sampled_kernel:
+        from tpu_gossip.kernels.pallas_segment import segment_sampled
+
+        if plan.fanout != cfg.fanout:
+            raise ValueError(
+                f"plan built for fanout={plan.fanout} but cfg.fanout={cfg.fanout}"
+            )
+        # pull ships the responder's full seen set (forward_once budgets
+        # gate pushing, never answering) — None = same array as transmit
+        answer = (state.seen & transmitter) if cfg.forward_once else None
+        return segment_sampled(
+            plan, transmit, answer, cfg.msg_slots, k_push,
+            receptive_rows=receptive.any(-1),
+            do_push=True, do_pull=(cfg.mode == "push_pull"),
+        )
     if cfg.mode in ("push", "push_pull"):
         tgt, valid = sample_fanout_targets(
             k_push, state.row_ptr, state.col_idx, cfg.fanout
         )
         if cfg.rewire_slots > 0:
+            k_rw_push, k_rw_rev = jax.random.split(k_rw_push)
             tgt, valid = _substitute_rewired(state, cfg, tgt, valid, k_rw_push)
             # stale-edge filter, symmetric with the pull half below: a CSR
             # edge pointing AT a rewired slot belongs to the departed
-            # occupant, so only fresh-edge traffic (rewired sender) reaches a
-            # rejoiner
+            # occupant, so only fresh-edge traffic reaches a rejoiner —
+            # outbound via the substituted targets above, inbound via the
+            # bidirectional reverse pass
             valid = valid & (state.rewired[:, None] | ~state.rewired[tgt])
+            rev, rev_msgs = reverse_fresh_push(state, cfg, transmit, k_rw_rev)
+            incoming = incoming | rev
+            msgs_sent = msgs_sent + rev_msgs
         push_valid = valid & transmit.any(-1)[:, None]
         incoming = incoming | push_fanout(transmit, tgt, push_valid)
         msgs_sent = msgs_sent + jnp.sum(
@@ -162,6 +193,35 @@ def _disseminate_local(
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
         msgs_sent = msgs_sent + jnp.sum(transmit.sum(-1, dtype=jnp.int32) * deg)
     return incoming, msgs_sent
+
+
+def reverse_fresh_push(
+    state: SwarmState, cfg: SwarmConfig, transmit: jax.Array, key: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Delivery TO rejoiners along the reverse of their fresh edges.
+
+    Re-wiring semantics are bidirectional, like the TCP connections a
+    socket-mode rejoin opens (reference Peer.py:233-256): a fresh edge
+    r -> t also carries t's pushes back to r, at t's per-edge push rate
+    ``fanout/deg(t)`` — without this, a rejoined peer in push mode could
+    never be re-infected (all its CSR in-edges are stale) and heavy-churn
+    swarms collapse. Returns ``(incoming, msgs)``; used by both engines.
+    """
+    s = cfg.rewire_slots
+    stgt = state.rewire_targets[:, :s]
+    tgt = jnp.maximum(stgt, 0)
+    deg = state.row_ptr[1:] - state.row_ptr[:-1]
+    p = cfg.fanout / jnp.maximum(deg[tgt], 1)
+    fire = (
+        state.rewired[:, None]
+        & (stgt >= 0)
+        & (jax.random.uniform(key, stgt.shape) < p)
+    )
+    got = transmit[tgt] & fire[:, :, None]  # (N, S, M)
+    msgs = jnp.sum(
+        transmit[tgt].sum(-1, dtype=jnp.int32) * fire.astype(jnp.int32)
+    )
+    return got.any(axis=1), msgs
 
 
 def _substitute_rewired(
