@@ -9,9 +9,25 @@ Metric per BASELINE.json: rounds-to-99%-coverage and peers·rounds/sec on a
 Runs are single on-device while_loops (compile + warmup excluded; min wall
 over 3 reps because the axon tunnel has high run-to-run variance).
 
-Graphs are built ON DEVICE (core/device_topology.py): at 10M nodes the host
-numpy path plus the CSR transfer costs ~80 s; the device pipeline builds the
-same erased configuration model in HBM in ~10 s (reported as setup_seconds).
+Every dissemination config is measured over BOTH delivery paths —
+``xla`` (gather + serialized `.at[].max` scatter, kernels/gossip.py) and
+``pallas`` (the staircase MXU kernel, kernels/pallas_segment.py: flood via
+``segment_or``, push/push-pull via ``segment_sampled`` — the north star's
+"single Pallas segment-scatter kernel" replacing the reference's per-socket
+send loop, reference Peer.py:395-408). The headline number is the faster
+path; both appear under ``configs`` so the comparison is reproducible from
+this artifact alone.
+
+Headline configs run ``msg_slots=16`` with one rumor seeded per slot
+(``init_swarm(origin_slots=...)``) so the dedup bitmap, packing, and (N, M)
+traffic the engine is designed around are all exercised; the historical
+``msg_slots=1`` shape is recorded too for cross-round comparability.
+
+North-star accounting is explicit: ``setup_seconds_cold`` (first on-device
+graph build, includes XLA compile) vs ``setup_seconds_warm`` (second build,
+compile cached — the steady-state cost), and ``met`` is defined as
+warm-setup + best sim wall < 60 s (``met_definition`` states this; the
+sim-only and cold-setup readings are also reported).
 
 ``vs_baseline`` compares against the reference's intrinsic socket-mode
 throughput: one gossip tick per 5 s per peer (reference Peer.py:396-408,
@@ -19,13 +35,16 @@ SURVEY.md §6) at its 1k-peer demonstrated scale ⇒ 1000 peers × 0.2
 rounds/sec = 200 peers·rounds/sec. The reference publishes no other numbers
 (readme.md:1-11; BASELINE.json "published": {}).
 
-The JSON also carries measured hardware ceilings (elementwise GB/s and
-random-access rate of this chip, measured in-run) and the per-config derived
-utilization, so round times are accountable: dissemination is bound by
-random gather/scatter access rate, not FLOPs (SURVEY.md §5.1 accounting).
+Hardware ceilings are measured on STREAMING-SCALE arrays (64 MiB, dispatch
+amortized over the loop) so they are comparable to chip spec — a v5e's HBM
+is ~819 GB/s; the measurement notes the spec fraction so utilization claims
+are not self-referential. Per-config ``access_rate_per_sec_M`` uses the
+random-access ceiling as denominator: dissemination is bound by random
+gather/scatter access rate, not FLOPs (SURVEY.md §5.1 accounting).
 
 Flags: --quick (1M only, 1 rep) · --dist (add a sharded-engine run on the
-available device mesh).
+available device mesh) · --profile DIR (jax.profiler trace of one warmed
+headline run).
 """
 
 from __future__ import annotations
@@ -36,67 +55,132 @@ import sys
 import time
 
 REFERENCE_PEERS_ROUNDS_PER_SEC = 200.0  # 1k peers, 1 round / 5 s (Peer.py:396-408)
+V5E_HBM_GBPS = 819.0  # public v5e spec, the sanity anchor for the measurement
 
 
 def _measure_ceilings(jax, jnp):
-    """Measure this chip's elementwise bandwidth and random-access rate with
-    tiny in-loop kernels (dispatch overhead amortized over 20 iters)."""
+    """Measure this chip's elementwise bandwidth and random-access rate.
+
+    Two-point slope method: time the same on-device fori_loop at N1 and N2
+    iterations and divide the difference by (N2 - N1), so the constant
+    per-dispatch + result-fetch latency (which dominates on the axon tunnel
+    and previously made the figure look ~100x under spec) cancels exactly.
+    64 MiB operands keep the loop body HBM-streaming-bound. The elementwise
+    figure is then comparable to chip spec (the JSON carries the spec
+    fraction); the random-access figure is the gather rate that actually
+    bounds gossip rounds.
+    """
     import numpy as np
 
-    n = 1_000_000
+    n = 16_777_216  # 64 MiB of int32
     a = jnp.asarray(np.random.default_rng(0).integers(0, 2**31, (n,), dtype=np.int32))
     idx = jnp.asarray(np.random.default_rng(1).integers(0, n, (n,), dtype=np.int32))
+    n1, n2 = 4, 64
 
-    def loop(body, carry, iters=20):
-        f = jax.jit(lambda c: jax.lax.fori_loop(0, iters, body, c))
-        out = f(carry)
-        _ = float(jnp.sum(out))  # fetch = completion barrier on axon
-        t0 = time.perf_counter()
-        out = f(carry)
-        _ = float(jnp.sum(out))
-        return (time.perf_counter() - t0) / iters
+    def slope(body, carry):
+        def run(iters):
+            f = jax.jit(
+                lambda c: jax.lax.fori_loop(0, iters, body, c), static_argnums=()
+            )
+            out = f(carry)
+            _ = float(jnp.sum(out))  # fetch = completion barrier on axon
+            best = float("inf")
+            for _rep in range(3):
+                t0 = time.perf_counter()
+                out = f(carry)
+                _ = float(jnp.sum(out))
+                best = min(best, time.perf_counter() - t0)
+            return best
 
-    # elementwise: read 2 x 4MB, write 4MB per iter
-    t_ew = loop(lambda i, c: c ^ (c | a), a)
-    # random gather: 1M 4-byte accesses per iter
-    t_g = loop(lambda i, c: c ^ a[(idx + i) % n], a)
+        return (run(n2) - run(n1)) / (n2 - n1)
+
+    # elementwise: read a + read c + write c = 3 x 64 MiB per iter
+    t_ew = slope(lambda i, c: c ^ (c | a), a)
+    # random gather: 16M 4-byte accesses per iter (plus the streaming write)
+    t_g = slope(lambda i, c: c ^ a[(idx + i) % n], a)
+    ew_gbps = 3 * 4 * n / max(t_ew, 1e-9) / 1e9
     return {
-        "elementwise_GBps": round(12e6 / max(t_ew, 1e-9) / 1e9, 2),
+        "elementwise_GBps": round(ew_gbps, 1),
+        "elementwise_frac_of_v5e_spec": round(ew_gbps / V5E_HBM_GBPS, 3),
         "random_access_per_sec_M": round(n / max(t_g, 1e-9) / 1e6, 1),
-        "note": "measured in-run on 1M-element ops; includes per-op overhead",
+        "note": "two-point slope over 4-vs-64-iter on-device loops, 64MiB "
+        "operands (dispatch+fetch latency cancels); spec anchor 819 GB/s (v5e HBM)",
     }
 
 
-def _accesses_per_round(cfg) -> int:
-    """Random HBM accesses per round (gather+scatter), the binding resource."""
+def _accesses_per_round(cfg, n_edges: int) -> int:
+    """Random HBM accesses per round (gather+scatter), the binding resource
+    for the XLA delivery path."""
     n = cfg.n_peers
     acc = 0
     if cfg.mode in ("push", "push_pull"):
         acc += 2 * n * cfg.fanout  # target gather + delivery scatter
     if cfg.mode == "push_pull":
         acc += 2 * n  # pull: neighbor gather + seen gather
+    if cfg.mode == "flood":
+        acc += 2 * n_edges  # every edge slot: transmit gather + delivery scatter
     return acc
 
 
-def bench_one(dg, mode: str, fanout: int, *, reps: int, max_rounds: int = 500):
+def _build_plan(dg, fanout, rows):
+    """Staircase plan over the padded CSR (host-side, once per graph).
+
+    Returns ``(plan, build_seconds)`` — the host transfer + numpy tiling
+    cost is part of honest accounting at 10M scale. ``rows`` per the on-TPU
+    tuning sweep (2026-07-30, 1M γ=2.5 m16): flood is fastest at rows=128
+    (130.6 ms vs 153.7 at 1024), sampled push_pull at rows=1024 (192.3 ms
+    vs 232.1 at 128) — each config below uses its tuned best so the
+    xla-vs-pallas comparison is against the kernel's strongest setting.
+    """
+    import numpy as np
+
+    from tpu_gossip.kernels.pallas_segment import build_staircase_plan
+
+    t0 = time.perf_counter()
+    plan = build_staircase_plan(
+        np.asarray(dg.row_ptr), np.asarray(dg.col_idx), fanout=fanout, rows=rows
+    )
+    return plan, time.perf_counter() - t0
+
+
+def bench_one(
+    dg,
+    mode: str,
+    fanout: int,
+    *,
+    msg_slots: int,
+    reps: int,
+    plan=None,
+    max_rounds: int = 500,
+):
     import jax
+    import numpy as np
 
     from tpu_gossip.core.state import SwarmConfig, init_swarm
     from tpu_gossip.sim.metrics import bench_swarm
 
-    cfg = SwarmConfig(n_peers=dg.n_pad, msg_slots=1, fanout=fanout, mode=mode)
+    cfg = SwarmConfig(n_peers=dg.n_pad, msg_slots=msg_slots, fanout=fanout, mode=mode)
+    # one rumor per slot (distinct origins) so every slot carries traffic;
+    # coverage/rounds-to-target are measured on slot 0 as always
+    origins = np.arange(msg_slots)
     state = init_swarm(
-        dg.as_padded_graph(), cfg, origins=[0], exists=dg.exists,
+        dg.as_padded_graph(), cfg, origins=origins,
+        origin_slots=np.arange(msg_slots), exists=dg.exists,
         key=jax.random.key(0),
     )
-    res, _ = bench_swarm(state, cfg, 0.99, max_rounds, reps=reps)
-    acc = _accesses_per_round(cfg)
-    return {
+    res, _ = bench_swarm(state, cfg, 0.99, max_rounds, reps=reps, plan=plan)
+    acc = _accesses_per_round(cfg, int(dg.col_idx.shape[0]))
+    out = {
         **{k: (round(v, 4) if isinstance(v, float) else v)
            for k, v in dataclasses.asdict(res).items()},
+        "msg_slots": msg_slots,
+        "delivery": "pallas" if plan is not None else "xla",
         "accesses_per_round_M": round(acc / 1e6, 2),
         "access_rate_per_sec_M": round(acc / max(res.ms_per_round, 1e-9) / 1e3, 1),
     }
+    if plan is not None:
+        out["plan_rows"] = plan.rows
+    return out
 
 
 def bench_dist(n: int):
@@ -104,7 +188,6 @@ def bench_dist(n: int):
     here; 8 virtual CPU devices under the test env) — the multi-chip path's
     single-host measurement; cross-chip scaling is validated structurally by
     __graft_entry__.dryrun_multichip."""
-    import jax
     import numpy as np
 
     from tpu_gossip.core.state import SwarmConfig
@@ -138,23 +221,60 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     quick = "--quick" in argv
     with_dist = "--dist" in argv
+    profile_dir = None
+    if "--profile" in argv:
+        i = argv.index("--profile")
+        if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            print("--profile requires a trace directory argument", file=sys.stderr)
+            return 2
+        profile_dir = argv[i + 1]
 
     import jax
     import jax.numpy as jnp
 
     from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.utils.profiling import trace
 
     reps = 1 if quick else 3
     ceilings = _measure_ceilings(jax, jnp)
 
-    # --- 1M standard configs ---------------------------------------------
+    # --- 1M graph + staircase plans --------------------------------------
     t0 = time.perf_counter()
     dg1 = device_powerlaw_graph(1_000_000, gamma=2.5, key=jax.random.key(0))
     int(dg1.row_ptr[-1])
     setup_1m = time.perf_counter() - t0
+    plan1_k1, plan1_k1_s = _build_plan(dg1, fanout=1, rows=1024)
+    plan1_k3, plan1_k3_s = (None, 0.0) if quick else _build_plan(dg1, fanout=3, rows=1024)
+    plan1_fl, plan1_fl_s = (None, 0.0) if quick else _build_plan(dg1, fanout=None, rows=128)
 
-    headline = bench_one(dg1, "push_pull", 1, reps=reps)
-    push3 = bench_one(dg1, "push", 3, reps=reps)
+    # --- 1M standard configs, both delivery paths ------------------------
+    hl_xla = bench_one(dg1, "push_pull", 1, msg_slots=16, reps=reps)
+    hl_pal = bench_one(dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1)
+    headline = min(hl_xla, hl_pal, key=lambda r: r["wall_seconds"])
+
+    configs = {
+        "push_pull_k1_m16_xla": hl_xla,
+        "push_pull_k1_m16_pallas": hl_pal,
+        # historical msg_slots=1 shape (cross-round comparability with r01/r02)
+        "push_pull_k1_m1_xla": bench_one(dg1, "push_pull", 1, msg_slots=1, reps=reps),
+    }
+    if not quick:
+        configs["push_k3_m16_xla"] = bench_one(dg1, "push", 3, msg_slots=16, reps=reps)
+        configs["push_k3_m16_pallas"] = bench_one(
+            dg1, "push", 3, msg_slots=16, reps=reps, plan=plan1_k3
+        )
+        # flood: the staircase kernel's original formulation, both paths
+        # (VERDICT r2 item 3: the kernel's win must live in this artifact)
+        configs["flood_m16_xla"] = bench_one(dg1, "flood", 1, msg_slots=16, reps=reps)
+        configs["flood_m16_staircase"] = bench_one(
+            dg1, "flood", 1, msg_slots=16, reps=reps, plan=plan1_fl
+        )
+
+    if profile_dir:
+        # one warmed headline rep under the device tracer (SURVEY.md §5.1)
+        with trace(profile_dir):
+            bench_one(dg1, "push_pull", 1, msg_slots=16, reps=1,
+                      plan=plan1_k1 if headline is hl_pal else None)
 
     out = {
         "metric": "1M-node power-law (gamma=2.5) push-pull gossip to 99% coverage",
@@ -163,8 +283,10 @@ def main(argv: list[str] | None = None) -> int:
         "vs_baseline": round(headline["peers_rounds_per_sec"] / REFERENCE_PEERS_ROUNDS_PER_SEC, 1),
         "rounds_to_99pct": headline["rounds"],
         "wall_seconds": headline["wall_seconds"],
+        "headline_delivery": headline["delivery"],
         "setup_seconds_1m": round(setup_1m, 2),
-        "configs": {"push_pull_k1": headline, "push_k3": push3},
+        "plan_build_seconds_1m": round(plan1_k1_s + plan1_k3_s + plan1_fl_s, 2),
+        "configs": configs,
         "hardware_ceilings": ceilings,
         "graph": "on-device erased configuration model (core/device_topology.py)",
     }
@@ -174,13 +296,26 @@ def main(argv: list[str] | None = None) -> int:
         t0 = time.perf_counter()
         dg10 = device_powerlaw_graph(10_000_000, gamma=2.5, key=jax.random.key(0))
         int(dg10.row_ptr[-1])
-        setup_10m = time.perf_counter() - t0
-        ns = bench_one(dg10, "push_pull", 1, reps=reps)
+        setup_cold = time.perf_counter() - t0
+        # second build, fresh key: compile is cached — the steady-state cost
+        t0 = time.perf_counter()
+        dg10 = device_powerlaw_graph(10_000_000, gamma=2.5, key=jax.random.key(1))
+        int(dg10.row_ptr[-1])
+        setup_warm = time.perf_counter() - t0
+        plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024)
+        ns_xla = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps)
+        ns_pal = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps, plan=plan10)
+        ns = min(ns_xla, ns_pal, key=lambda r: r["wall_seconds"])
         out["north_star"] = {
             **ns,
-            "setup_seconds": round(setup_10m, 2),
+            "xla": ns_xla, "pallas": ns_pal,
+            "setup_seconds_cold": round(setup_cold, 2),
+            "setup_seconds_warm": round(setup_warm, 2),
+            "plan_build_seconds": round(plan10_s, 2),
             "target": "10M peers to 99% < 60 s (BASELINE.json north_star)",
-            "met": bool(ns["wall_seconds"] < 60.0),
+            "met_definition": "setup_seconds_warm + best sim wall_seconds < 60",
+            "met_sim_only": bool(ns["wall_seconds"] < 60.0),
+            "met": bool(setup_warm + ns["wall_seconds"] < 60.0),
         }
 
     if with_dist:

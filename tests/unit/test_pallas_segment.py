@@ -33,14 +33,36 @@ def test_pack_roundtrip():
         pack_words(jnp.zeros((4, 33), dtype=bool))
 
 
-@pytest.mark.parametrize("m", [1, 8, 24])
+@pytest.mark.parametrize("m", [1, 8, 24, 33, 64, 70])
 def test_parity_with_flood_all(m):
+    """m > 32 exercises the multi-word path (one launch per 32-slot group)."""
     for g in graphs():
         plan = build_staircase_plan(g.row_ptr, g.col_idx)
         transmit = jnp.asarray(np.random.default_rng(2).random((g.n, m)) < 0.25)
         ref = flood_all(transmit, jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx))
         got = segment_or(plan, transmit, m)
         assert bool(jnp.array_equal(ref, got)), f"mismatch n={g.n} m={m}"
+
+
+@pytest.mark.parametrize("rows", [256, 512])
+def test_parity_with_wider_blocks(rows):
+    """rows > 128 (the tile-count-vs-compute knob) keeps exact parity, for
+    both flood and saturated-fanout sampled delivery, pull included."""
+    for g in graphs():
+        max_deg = int(np.max(np.diff(np.asarray(g.row_ptr))))
+        plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=max_deg, rows=rows)
+        assert plan.rows == rows
+        transmit = jnp.asarray(np.random.default_rng(6).random((g.n, 8)) < 0.3)
+        ref = flood_all(transmit, jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx))
+        assert bool(jnp.array_equal(ref, segment_or(plan, transmit, 8)))
+        got, _ = segment_sampled(
+            plan, transmit, None, 8, jax.random.key(1),
+            receptive_rows=jnp.ones((g.n,), dtype=bool),
+            do_push=True, do_pull=True,
+        )
+        assert bool(jnp.array_equal(ref, got))
+    with pytest.raises(ValueError, match="multiple of 128"):
+        build_staircase_plan(g.row_ptr, g.col_idx, rows=100)
 
 
 def test_plan_covers_every_block():
@@ -96,6 +118,46 @@ def test_sampled_activation_rate_matches_expectation():
     expected = np.minimum(k, deg).sum()  # senders with deg<k fire all edges
     got = total / reps
     assert abs(got - expected) / expected < 0.05, (got, expected)
+
+
+def test_sampled_multiword_activation_is_edge_consistent():
+    """M > 32: the Bernoulli draw is per EDGE, not per word group — with
+    saturated fanout every edge fires, so sampled delivery across 2+ words
+    must equal the flood of the full-width bitmap (bit-exact)."""
+    g = next(iter(graphs()))
+    max_deg = int(np.max(np.diff(np.asarray(g.row_ptr))))
+    plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=max_deg)
+    m = 50
+    transmit = jnp.asarray(np.random.default_rng(9).random((g.n, m)) < 0.3)
+    ref = flood_all(transmit, jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx))
+    got, msgs = segment_sampled(
+        plan, transmit, transmit, m, jax.random.key(0), do_push=True
+    )
+    assert bool(jnp.array_equal(ref, got))
+    assert int(msgs) == int(
+        jnp.sum(transmit.sum(-1) * jnp.diff(jnp.asarray(g.row_ptr)))
+    )
+
+
+def test_sampled_multiword_subsampled_edges_agree_across_words():
+    """With a non-saturating fanout, a fired edge must deliver ALL its word
+    groups: no (dst, src-word) combination where word 0 arrived but word 1
+    didn't, given the sender offered both. Seed each sender's slots 0 and 40
+    identically, so any cross-word disagreement in delivery is a shared-draw
+    violation."""
+    g = next(iter(graphs()))
+    plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=2)
+    m = 48
+    rng = np.random.default_rng(10)
+    base = rng.random(g.n) < 0.5
+    transmit = np.zeros((g.n, m), dtype=bool)
+    transmit[:, 0] = base
+    transmit[:, 40] = base
+    got, _ = segment_sampled(
+        plan, jnp.asarray(transmit), None, m, jax.random.key(4), do_push=True
+    )
+    got = np.asarray(got)
+    np.testing.assert_array_equal(got[:, 0], got[:, 40])
 
 
 def test_sampled_pull_requires_thresholds():

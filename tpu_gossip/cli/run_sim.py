@@ -53,10 +53,15 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="deliver via the Pallas staircase kernel: exact segment-OR for "
         "flood, Bernoulli-per-edge sampling for push/push_pull (needs "
-        "--rewire-slots 0 and --slots <= 32)",
+        "--rewire-slots 0; any --slots width, one launch per 32 slots)",
     )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
+    p.add_argument(
+        "--profile", type=str, default="",
+        help="record a jax.profiler device trace of the run into this directory "
+        "(view with TensorBoard/xprof; SURVEY.md §5.1)",
+    )
     return p
 
 
@@ -91,10 +96,6 @@ def main(argv: list[str] | None = None) -> int:
     )
     plan = None
     if args.staircase:
-        if args.slots > 32:
-            print("--staircase packs slots into one int32 word: --slots must be <= 32",
-                  file=sys.stderr)
-            return 2
         if args.rewire_slots > 0 and args.mode != "flood":
             print("--staircase sampling uses static edge tables: not compatible "
                   "with --rewire-slots (churn re-wiring runs the XLA path)",
@@ -114,23 +115,26 @@ def main(argv: list[str] | None = None) -> int:
         silent_ids = rng.choice(args.peers, size=k, replace=False)
         state.silent = state.silent.at[silent_ids].set(True)
 
-    if args.rounds > 0:
-        fin, stats = simulate(state, cfg, args.rounds, plan)
-        if not args.quiet:
-            M.write_jsonl(stats, sys.stdout)
-        rounds = M.rounds_to_coverage(stats, args.target)
-        summary = {
-            "summary": True,
-            "n_peers": args.peers,
-            "mode": args.mode,
-            "rounds_run": args.rounds,
-            "rounds_to_target": rounds,
-            "final_coverage": float(np.asarray(stats.coverage)[-1]),
-            "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
-        }
-    else:
-        result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds, plan=plan)
-        summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
+    from tpu_gossip.utils.profiling import trace
+
+    with trace(args.profile):
+        if args.rounds > 0:
+            fin, stats = simulate(state, cfg, args.rounds, plan)
+            if not args.quiet:
+                M.write_jsonl(stats, sys.stdout)
+            rounds = M.rounds_to_coverage(stats, args.target)
+            summary = {
+                "summary": True,
+                "n_peers": args.peers,
+                "mode": args.mode,
+                "rounds_run": args.rounds,
+                "rounds_to_target": rounds,
+                "final_coverage": float(np.asarray(stats.coverage)[-1]),
+                "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
+            }
+        else:
+            result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds, plan=plan)
+            summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
     print(json.dumps(summary))
 
     if args.checkpoint:

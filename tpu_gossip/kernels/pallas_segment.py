@@ -7,10 +7,14 @@ send loop (reference Peer.py:395-408) with a segment reduction over the CSR:
 (``segment_max`` over a (D, M) gather) is slow on TPU — the reduction
 serializes — so this module reformulates it for the MXU:
 
-- Message bitmaps are PACKED into one int32 word per peer (M <= 32 slots).
+- Message bitmaps are PACKED into int32 words per peer: one word when
+  M <= 32, else one kernel launch per 32-slot word group (the edge-level
+  activation draw is shared across groups, so sampling semantics don't
+  depend on M).
 - Edges, already destination-grouped by the CSR, are cut into 1024-edge
-  tiles that never cross a 128-row output block boundary (host-side plan,
-  static per graph).
+  tiles that never cross an output block boundary (host-side plan, static
+  per graph; block height ``rows`` is tunable — low-degree graphs want
+  wider blocks, see :func:`build_staircase_plan`).
 - Per tile, the kernel unpacks words into M bit-planes, builds the tile's
   "staircase" one-hot (row r vs per-edge local offset) with an iota
   compare, and contracts both on the MXU:
@@ -53,7 +57,7 @@ __all__ = [
     "segment_sampled",
 ]
 
-ROWS = 128  # output rows per block (out block last dim)
+ROWS = 128  # default output rows per block (out block last dim)
 TILE = 1024  # edges per tile, stored (8, 128)
 
 
@@ -68,7 +72,7 @@ class StaircasePlan:
 
     tile_block: jax.Array  # int32 (T,) — output block index per tile
     first_visit: jax.Array  # int32 (T,) — 1 iff first tile of its block
-    offs: jax.Array  # int32 (T*8, 128) — local row offset in [0, ROWS) or -1
+    offs: jax.Array  # int32 (T*8, 128) — local row offset in [0, rows) or -1
     col_gather: jax.Array  # int32 (T*8, 128) — graph col_idx per edge slot (pad 0)
     n: int = dataclasses.field(metadata=dict(static=True))
     n_tiles: int = dataclasses.field(metadata=dict(static=True))
@@ -76,6 +80,7 @@ class StaircasePlan:
     push_thresh: jax.Array | None = None  # uint32 (T*8, 128) — P(edge fires) for push
     pull_thresh: jax.Array | None = None  # uint32 (T*8, 128) — P(edge fires) for pull
     fanout: int | None = dataclasses.field(default=None, metadata=dict(static=True))
+    rows: int = dataclasses.field(default=ROWS, metadata=dict(static=True))
 
 
 def _bernoulli_threshold(p: np.ndarray) -> np.ndarray:
@@ -87,22 +92,35 @@ def _bernoulli_threshold(p: np.ndarray) -> np.ndarray:
 
 
 def build_staircase_plan(
-    row_ptr: np.ndarray, col_idx: np.ndarray, fanout: int | None = None
+    row_ptr: np.ndarray,
+    col_idx: np.ndarray,
+    fanout: int | None = None,
+    *,
+    rows: int = ROWS,
 ) -> StaircasePlan:
     """Cut the CSR's destination-grouped edges into MXU tiles (host, once).
 
-    Every 128-row output block gets >= 1 tile (so the kernel zero-initializes
-    every block), and no tile spans two blocks (so accumulation is pure
-    block revisiting). With ``fanout``, also precompute the sampled-delivery
-    Bernoulli thresholds (enables :func:`segment_sampled`).
+    Every ``rows``-row output block gets >= 1 tile (so the kernel
+    zero-initializes every block), and no tile spans two blocks (so
+    accumulation is pure block revisiting). With ``fanout``, also precompute
+    the sampled-delivery Bernoulli thresholds (enables
+    :func:`segment_sampled`).
+
+    ``rows`` trades tile count against per-tile compute: low-mean-degree
+    graphs are tile-count-bound at rows=128 (a 128-row block holds ~128·d̄
+    edges, far below the 1024-edge tile), so widening the block to 512 rows
+    cuts the sequential grid ~4x for d̄ ≲ 2 while the MXU contraction stays
+    (m, 1024) x (1024, rows). Must be a multiple of 128 (lane width).
     """
+    if rows % 128 != 0 or rows <= 0:
+        raise ValueError(f"rows must be a positive multiple of 128, got {rows}")
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     col_idx = np.asarray(col_idx, dtype=np.int64)
     n = len(row_ptr) - 1
-    n_blocks = max(1, math.ceil(n / ROWS))
+    n_blocks = max(1, math.ceil(n / rows))
 
-    starts = row_ptr[np.minimum(np.arange(n_blocks) * ROWS, n)]
-    ends = row_ptr[np.minimum((np.arange(n_blocks) + 1) * ROWS, n)]
+    starts = row_ptr[np.minimum(np.arange(n_blocks) * rows, n)]
+    ends = row_ptr[np.minimum((np.arange(n_blocks) + 1) * rows, n)]
     spans = ends - starts
     tiles_per_block = np.maximum(1, np.ceil(spans / TILE).astype(np.int64))
     T = int(tiles_per_block.sum())
@@ -129,7 +147,7 @@ def build_staircase_plan(
     eidx_safe = np.where(valid, eidx, 0)
     edge_dst = dst[eidx_safe]  # CSR row (receiver) per edge slot
     offs = np.where(
-        valid, edge_dst - tile_block[:, None].astype(np.int64) * ROWS, -1
+        valid, edge_dst - tile_block[:, None].astype(np.int64) * rows, -1
     ).astype(np.int32)
     cols = np.where(valid, col_idx[eidx_safe], 0).astype(np.int32)
 
@@ -169,6 +187,7 @@ def build_staircase_plan(
         push_thresh=push_thresh,
         pull_thresh=pull_thresh,
         fanout=fanout,
+        rows=rows,
     )
 
 
@@ -181,12 +200,17 @@ def pack_words(bitmap: jax.Array) -> jax.Array:
     return jnp.sum(bitmap.astype(jnp.int32) * weights, axis=1, dtype=jnp.int32)
 
 
+def _slot_groups(m: int) -> list[tuple[int, int]]:
+    """[(lo, width), ...] cutting M slots into <=32-bit word groups."""
+    return [(lo, min(32, m - lo)) for lo in range(0, m, 32)]
+
+
 def unpack_words(words: jax.Array, m: int) -> jax.Array:
     """(N,) int32 -> (N, m) bool."""
     return ((words[:, None] >> jnp.arange(m, dtype=jnp.int32)[None, :]) & 1).astype(bool)
 
 
-def _kernel(m: int):
+def _kernel(m: int, rows: int):
     def kernel(tb_ref, fv_ref, offs_ref, vals_ref, out_ref):
         t = pl.program_id(0)
         offs = offs_ref[:].reshape(1, TILE)  # (1, 1024)
@@ -195,12 +219,12 @@ def _kernel(m: int):
             [(words >> s) & 1 for s in range(m)], axis=0
         ).astype(jnp.float32)  # (m, 1024)
         onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, (ROWS, TILE), 0) == offs
-        ).astype(jnp.float32)  # (128, 1024); offs=-1 matches nothing
+            jax.lax.broadcasted_iota(jnp.int32, (rows, TILE), 0) == offs
+        ).astype(jnp.float32)  # (rows, 1024); offs=-1 matches nothing
         acc = jax.lax.dot_general(
             bits, onehot, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (m, 128)
+        )  # (m, rows)
 
         @pl.when(fv_ref[t] == 1)
         def _():
@@ -220,6 +244,7 @@ def _launch(
     ``vals`` (T*8, 128) int32 → (N, m) bool segment-OR by destination row."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    rows = plan.rows
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(plan.n_tiles,),
@@ -227,16 +252,16 @@ def _launch(
             pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0)),
             pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0)),
         ],
-        out_specs=pl.BlockSpec((1, m, ROWS), lambda t, tb, fv: (tb[t], 0, 0)),
+        out_specs=pl.BlockSpec((1, m, rows), lambda t, tb, fv: (tb[t], 0, 0)),
     )
     out = pl.pallas_call(
-        _kernel(m),
-        out_shape=jax.ShapeDtypeStruct((plan.n_blocks, m, ROWS), jnp.float32),
+        _kernel(m, rows),
+        out_shape=jax.ShapeDtypeStruct((plan.n_blocks, m, rows), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(plan.tile_block, plan.first_visit, plan.offs, vals)
-    # (NB, m, ROWS) -> (NB*ROWS, m) rows-major, trim padding rows
-    inc = out.transpose(0, 2, 1).reshape(plan.n_blocks * ROWS, m)
+    # (NB, m, rows) -> (NB*rows, m) rows-major, trim padding rows
+    inc = out.transpose(0, 2, 1).reshape(plan.n_blocks * rows, m)
     return inc[: plan.n] > 0.5
 
 
@@ -247,10 +272,14 @@ def segment_or(
     """incoming[i] = OR over CSR neighbors j of transmit[j] — flood delivery.
 
     ``transmit``: (N, m) bool. One XLA gather (packed words along the edge
-    tiles) + one Pallas launch. Bit-exact vs ``kernels.gossip.flood_all``.
+    tiles) + one Pallas launch per 32-slot word group (one launch when
+    ``m <= 32``). Bit-exact vs ``kernels.gossip.flood_all``.
     """
-    vals = pack_words(transmit)[plan.col_gather]  # (T*8, 128) int32
-    return _launch(plan, vals, m, interpret)
+    outs = []
+    for lo, w in _slot_groups(m):
+        vals = pack_words(transmit[:, lo : lo + w])[plan.col_gather]
+        outs.append(_launch(plan, vals, w, interpret))
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "do_push", "do_pull", "interpret"))
@@ -288,35 +317,47 @@ def segment_sampled(
         raise ValueError("plan built without fanout — no sampling thresholds")
     shape = plan.col_gather.shape
     k_push, k_pull = jax.random.split(key)
-    w_push = pack_words(transmit)[plan.col_gather]
-    combined = jnp.zeros(shape, jnp.int32)
     msgs = jnp.zeros((), jnp.int32)
+    # edge-level activation is drawn ONCE and shared across all word groups:
+    # an edge either fires this round or not, regardless of how many 32-slot
+    # words the bitmap spans
+    active_p = active_q = None
     if do_push:
         active_p = jax.random.bits(k_push, shape, jnp.uint32) < plan.push_thresh
-        wp = jnp.where(active_p, w_push, 0)
-        combined = combined | wp
-        msgs = msgs + jnp.sum(jax.lax.population_count(wp), dtype=jnp.int32)
     if do_pull:
-        w_ans = w_push if answer is None else pack_words(answer)[plan.col_gather]
         active_q = jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_thresh
         if receptive_rows is not None:
             # per-edge puller mask via the plan's block structure: edge slot
-            # (tile t, local row offs) pulls for peer tile_block[t]*128+offs,
-            # so a (T, 128) row-gather indexed by offs suffices — no full
+            # (tile t, local row offs) pulls for peer tile_block[t]*rows+offs,
+            # so a (T, rows) row-gather indexed by offs suffices — no full
             # random gather
             t8, _ = shape
             t = t8 // 8
-            pad = plan.n_blocks * ROWS - receptive_rows.shape[0]
-            rec = jnp.pad(receptive_rows, (0, pad)).reshape(plan.n_blocks, ROWS)
+            pad = plan.n_blocks * plan.rows - receptive_rows.shape[0]
+            rec = jnp.pad(receptive_rows, (0, pad)).reshape(plan.n_blocks, plan.rows)
             rec_tiles = rec[plan.tile_block]  # (T, 128)
             rec_edge = jnp.take_along_axis(
                 rec_tiles, jnp.maximum(plan.offs.reshape(t, 8 * 128), 0), axis=1
             ).reshape(shape)
             active_q = active_q & rec_edge
-        wq = jnp.where(active_q, w_ans, 0)
-        combined = combined | wq
-        # one request per fired pull edge + the responder's shipped bits
-        msgs = msgs + jnp.sum(active_q, dtype=jnp.int32) + jnp.sum(
-            jax.lax.population_count(wq), dtype=jnp.int32
-        )
-    return _launch(plan, combined, m, interpret), msgs
+        # one request per fired pull edge (edge-level, counted once)
+        msgs = msgs + jnp.sum(active_q, dtype=jnp.int32)
+    outs = []
+    for lo, w in _slot_groups(m):
+        w_push = pack_words(transmit[:, lo : lo + w])[plan.col_gather]
+        combined = jnp.zeros(shape, jnp.int32)
+        if do_push:
+            wp = jnp.where(active_p, w_push, 0)
+            combined = combined | wp
+            msgs = msgs + jnp.sum(jax.lax.population_count(wp), dtype=jnp.int32)
+        if do_pull:
+            w_ans = (
+                w_push if answer is None
+                else pack_words(answer[:, lo : lo + w])[plan.col_gather]
+            )
+            wq = jnp.where(active_q, w_ans, 0)
+            combined = combined | wq
+            msgs = msgs + jnp.sum(jax.lax.population_count(wq), dtype=jnp.int32)
+        outs.append(_launch(plan, combined, w, interpret))
+    incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    return incoming, msgs

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (profiling hooks; SURVEY.md §5.1)."""
+
+from tpu_gossip.utils.profiling import trace
+
+__all__ = ["trace"]

@@ -1,0 +1,40 @@
+"""Profiler tracing hook (SURVEY.md §5.1).
+
+The reference's only visibility into runtime behavior is timestamped log
+lines (reference Peer.py:40-49, Seed.py:78-87) — "log-line archaeology".
+The TPU-native replacement is a real device trace: wrap any region (a bench
+run, a simulate() horizon) in :func:`trace` and XLA records per-op device
+timelines viewable in TensorBoard / Perfetto (`xprof`). Exposed as
+``--profile DIR`` on ``bench.py`` and ``cli/run_sim.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+from typing import Iterator
+
+__all__ = ["trace"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path | None) -> Iterator[None]:
+    """Record a ``jax.profiler`` device trace into ``log_dir``.
+
+    No-op when ``log_dir`` is falsy, so call sites can pass the CLI flag
+    straight through. The caller is responsible for making the traced region
+    representative (warmed-up, compile excluded) — tracing a cold run records
+    mostly compilation.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    path = Path(log_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(path))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
