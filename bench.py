@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import sys
 import time
 
@@ -75,9 +76,16 @@ def _measure_ceilings(jax, jnp):
     n = 16_777_216  # 64 MiB of int32
     a = jnp.asarray(np.random.default_rng(0).integers(0, 2**31, (n,), dtype=np.int32))
     idx = jnp.asarray(np.random.default_rng(1).integers(0, n, (n,), dtype=np.int32))
-    n1, n2 = 4, 64
 
-    def slope(body, carry):
+    def slope(body, carry, n1, n2):
+        """Per-iteration seconds, by timing n1- vs n2-iteration loops.
+
+        n2 - n1 must be large enough that the extra device time clears the
+        tunnel's run-to-run noise (tens of ms) — the elementwise body is
+        ~0.25 ms/iter at spec, hence its much larger n2. A nonpositive
+        slope (noise won) returns NaN rather than an absurd ceiling.
+        """
+
         def run(iters):
             f = jax.jit(
                 lambda c: jax.lax.fori_loop(0, iters, body, c), static_argnums=()
@@ -92,19 +100,24 @@ def _measure_ceilings(jax, jnp):
                 best = min(best, time.perf_counter() - t0)
             return best
 
-        return (run(n2) - run(n1)) / (n2 - n1)
+        dt = (run(n2) - run(n1)) / (n2 - n1)
+        return dt if dt > 0 else float("nan")
 
     # elementwise: read a + read c + write c = 3 x 64 MiB per iter
-    t_ew = slope(lambda i, c: c ^ (c | a), a)
+    t_ew = slope(lambda i, c: c ^ (c | a), a, 32, 512)
     # random gather: 16M 4-byte accesses per iter (plus the streaming write)
-    t_g = slope(lambda i, c: c ^ a[(idx + i) % n], a)
-    ew_gbps = 3 * 4 * n / max(t_ew, 1e-9) / 1e9
+    t_g = slope(lambda i, c: c ^ a[(idx + i) % n], a, 4, 64)
+    def fin(x, digits):  # NaN -> None so the JSON line stays strictly parseable
+        return round(x, digits) if math.isfinite(x) else None
+
+    ew_gbps = 3 * 4 * n / t_ew / 1e9
     return {
-        "elementwise_GBps": round(ew_gbps, 1),
-        "elementwise_frac_of_v5e_spec": round(ew_gbps / V5E_HBM_GBPS, 3),
-        "random_access_per_sec_M": round(n / max(t_g, 1e-9) / 1e6, 1),
-        "note": "two-point slope over 4-vs-64-iter on-device loops, 64MiB "
-        "operands (dispatch+fetch latency cancels); spec anchor 819 GB/s (v5e HBM)",
+        "elementwise_GBps": fin(ew_gbps, 1),
+        "elementwise_frac_of_v5e_spec": fin(ew_gbps / V5E_HBM_GBPS, 3),
+        "random_access_per_sec_M": fin(n / t_g / 1e6, 1),
+        "note": "two-point slope over short-vs-long on-device loops, 64MiB "
+        "operands (dispatch+fetch latency cancels); spec anchor 819 GB/s "
+        "(v5e HBM) — frac > 1 means a newer-generation part (v6e ~1.64 TB/s)",
     }
 
 
@@ -122,24 +135,36 @@ def _accesses_per_round(cfg, n_edges: int) -> int:
     return acc
 
 
-def _build_plan(dg, fanout, rows):
-    """Staircase plan over the padded CSR (host-side, once per graph).
+def _build_plan(dg, fanout, rows, device=False):
+    """Staircase plan over the padded CSR (once per graph).
 
-    Returns ``(plan, build_seconds)`` — the host transfer + numpy tiling
-    cost is part of honest accounting at 10M scale. ``rows`` per the on-TPU
-    tuning sweep (2026-07-30, 1M γ=2.5 m16): flood is fastest at rows=128
+    Returns ``(plan, build_seconds)`` — plan prep is part of honest
+    end-to-end accounting. ``device=True`` uses the on-device builder
+    (build_staircase_plan_device): right at 10M scale, where the host
+    build's ~620 MB of CSR-down + tables-up tunnel traffic costs ~90 s and
+    the device build pays only one jit compile; at 1M the host build's few
+    seconds beat the compile, so it stays. ``rows`` per the on-TPU tuning
+    sweep (2026-07-30, 1M γ=2.5 m16): flood is fastest at rows=128
     (130.6 ms vs 153.7 at 1024), sampled push_pull at rows=1024 (192.3 ms
     vs 232.1 at 128) — each config below uses its tuned best so the
     xla-vs-pallas comparison is against the kernel's strongest setting.
     """
     import numpy as np
 
-    from tpu_gossip.kernels.pallas_segment import build_staircase_plan
+    from tpu_gossip.kernels.pallas_segment import (
+        build_staircase_plan, build_staircase_plan_device,
+    )
 
     t0 = time.perf_counter()
-    plan = build_staircase_plan(
-        np.asarray(dg.row_ptr), np.asarray(dg.col_idx), fanout=fanout, rows=rows
-    )
+    if device:
+        plan = build_staircase_plan_device(
+            dg.row_ptr, dg.col_idx, fanout=fanout, rows=rows
+        )
+        int(plan.offs[-1, -1])  # scalar fetch = completion barrier on axon
+    else:
+        plan = build_staircase_plan(
+            np.asarray(dg.row_ptr), np.asarray(dg.col_idx), fanout=fanout, rows=rows
+        )
     return plan, time.perf_counter() - t0
 
 
@@ -176,10 +201,15 @@ def bench_one(
         "msg_slots": msg_slots,
         "delivery": "pallas" if plan is not None else "xla",
         "accesses_per_round_M": round(acc / 1e6, 2),
-        "access_rate_per_sec_M": round(acc / max(res.ms_per_round, 1e-9) / 1e3, 1),
     }
     if plan is not None:
+        # the staircase kernel streams edge tiles through the MXU — random
+        # access is not its binding resource, so no utilization rate here
         out["plan_rows"] = plan.rows
+    else:
+        out["access_rate_per_sec_M"] = round(
+            acc / max(res.ms_per_round, 1e-9) / 1e3, 1
+        )
     return out
 
 
@@ -266,7 +296,7 @@ def main(argv: list[str] | None = None) -> int:
         # flood: the staircase kernel's original formulation, both paths
         # (VERDICT r2 item 3: the kernel's win must live in this artifact)
         configs["flood_m16_xla"] = bench_one(dg1, "flood", 1, msg_slots=16, reps=reps)
-        configs["flood_m16_staircase"] = bench_one(
+        configs["flood_m16_pallas"] = bench_one(
             dg1, "flood", 1, msg_slots=16, reps=reps, plan=plan1_fl
         )
 
@@ -302,20 +332,28 @@ def main(argv: list[str] | None = None) -> int:
         dg10 = device_powerlaw_graph(10_000_000, gamma=2.5, key=jax.random.key(1))
         int(dg10.row_ptr[-1])
         setup_warm = time.perf_counter() - t0
-        plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024)
+        plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
         ns_xla = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps)
         ns_pal = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps, plan=plan10)
-        ns = min(ns_xla, ns_pal, key=lambda r: r["wall_seconds"])
+        # end-to-end cost per path: each path is charged EVERYTHING it needs
+        # beyond the warm graph build — the pallas path needs its staircase
+        # plan, the xla path needs nothing extra — so 'met' can't hide a
+        # 90 s plan build behind a marginally faster sim wall
+        e2e_xla = setup_warm + ns_xla["wall_seconds"]
+        e2e_pal = setup_warm + plan10_s + ns_pal["wall_seconds"]
+        ns = ns_xla if e2e_xla <= e2e_pal else ns_pal
         out["north_star"] = {
             **ns,
-            "xla": ns_xla, "pallas": ns_pal,
+            "xla": {**ns_xla, "end_to_end_seconds": round(e2e_xla, 2)},
+            "pallas": {**ns_pal, "end_to_end_seconds": round(e2e_pal, 2)},
             "setup_seconds_cold": round(setup_cold, 2),
             "setup_seconds_warm": round(setup_warm, 2),
             "plan_build_seconds": round(plan10_s, 2),
             "target": "10M peers to 99% < 60 s (BASELINE.json north_star)",
-            "met_definition": "setup_seconds_warm + best sim wall_seconds < 60",
-            "met_sim_only": bool(ns["wall_seconds"] < 60.0),
-            "met": bool(setup_warm + ns["wall_seconds"] < 60.0),
+            "met_definition": "min over delivery paths of (setup_seconds_warm "
+            "+ path-specific prep + sim wall_seconds) < 60",
+            "met_sim_only": bool(min(ns_xla["wall_seconds"], ns_pal["wall_seconds"]) < 60.0),
+            "met": bool(min(e2e_xla, e2e_pal) < 60.0),
         }
 
     if with_dist:

@@ -11,6 +11,7 @@ from tpu_gossip.core.topology import build_csr, configuration_model, powerlaw_de
 from tpu_gossip.kernels.gossip import flood_all
 from tpu_gossip.kernels.pallas_segment import (
     build_staircase_plan,
+    build_staircase_plan_device,
     pack_words,
     segment_or,
     segment_sampled,
@@ -63,6 +64,37 @@ def test_parity_with_wider_blocks(rows):
         assert bool(jnp.array_equal(ref, got))
     with pytest.raises(ValueError, match="multiple of 128"):
         build_staircase_plan(g.row_ptr, g.col_idx, rows=100)
+
+
+@pytest.mark.parametrize("rows,fanout", [(128, None), (128, 2), (512, 3)])
+def test_device_plan_matches_host_plan(rows, fanout):
+    """build_staircase_plan_device: routing tables bit-exact vs the host
+    build; Bernoulli thresholds within f32 rounding of the host's f64."""
+    for g in graphs():
+        hp = build_staircase_plan(g.row_ptr, g.col_idx, fanout=fanout, rows=rows)
+        dp = build_staircase_plan_device(
+            jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx), fanout=fanout, rows=rows
+        )
+        assert (dp.n, dp.n_tiles, dp.n_blocks, dp.rows, dp.fanout) == (
+            hp.n, hp.n_tiles, hp.n_blocks, hp.rows, hp.fanout
+        )
+        for f in ("tile_block", "first_visit", "offs", "col_gather"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dp, f)), np.asarray(getattr(hp, f)), err_msg=f
+            )
+        if fanout is None:
+            assert dp.push_thresh is None and dp.pull_thresh is None
+        else:
+            for f in ("push_thresh", "pull_thresh"):
+                h = np.asarray(getattr(hp, f)).astype(np.int64)
+                d = np.asarray(getattr(dp, f)).astype(np.int64)
+                # ~2^-24 relative agreement: |Δthresh| <= max(512, thresh>>23)
+                tol = np.maximum(512, h >> 23)
+                assert (np.abs(h - d) <= tol).all(), f
+        # and the kernel accepts the device-built plan
+        transmit = jnp.asarray(np.random.default_rng(4).random((g.n, 8)) < 0.3)
+        ref = flood_all(transmit, jnp.asarray(g.row_ptr), jnp.asarray(g.col_idx))
+        assert bool(jnp.array_equal(ref, segment_or(dp, transmit, 8)))
 
 
 def test_plan_covers_every_block():

@@ -51,6 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = [
     "StaircasePlan",
     "build_staircase_plan",
+    "build_staircase_plan_device",
     "pack_words",
     "unpack_words",
     "segment_or",
@@ -183,6 +184,137 @@ def build_staircase_plan(
         col_gather=jnp.asarray(cols.reshape(T * 8, 128)),
         n=n,
         n_tiles=T,
+        n_blocks=n_blocks,
+        push_thresh=push_thresh,
+        pull_thresh=pull_thresh,
+        fanout=fanout,
+        rows=rows,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks", "rows"))
+def _tiles_per_block(row_ptr: jax.Array, n: int, n_blocks: int, rows: int):
+    blocks = jnp.arange(n_blocks, dtype=jnp.int32)
+    starts = row_ptr[jnp.minimum(blocks * rows, n)]
+    ends = row_ptr[jnp.minimum((blocks + 1) * rows, n)]
+    return jnp.maximum(1, -(-(ends - starts) // TILE))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "n_blocks", "n_tiles", "rows", "fanout")
+)
+def _plan_tables_device(
+    row_ptr: jax.Array,
+    col_idx: jax.Array,
+    tpb: jax.Array,
+    *,
+    n: int,
+    n_blocks: int,
+    n_tiles: int,
+    rows: int,
+    fanout: int | None,
+):
+    T = n_tiles
+    blocks = jnp.arange(n_blocks, dtype=jnp.int32)
+    starts = row_ptr[jnp.minimum(blocks * rows, n)]
+    ends = row_ptr[jnp.minimum((blocks + 1) * rows, n)]
+
+    tile_block = jnp.repeat(blocks, tpb, total_repeat_length=T)
+    first_visit = jnp.ones((T,), dtype=jnp.int32)
+    first_visit = first_visit.at[1:].set(
+        (tile_block[1:] != tile_block[:-1]).astype(jnp.int32)
+    )
+    tile_ord = jnp.arange(T, dtype=jnp.int32) - (jnp.cumsum(tpb) - tpb)[tile_block]
+    tile_start = starts[tile_block] + tile_ord * TILE
+    tile_len = jnp.clip(ends[tile_block] - tile_start, 0, TILE)
+
+    deg = row_ptr[1:] - row_ptr[:-1]
+    d_total = col_idx.shape[0]
+    dst = jnp.repeat(
+        jnp.arange(n, dtype=jnp.int32), deg, total_repeat_length=d_total
+    )
+    slot = jnp.arange(TILE, dtype=jnp.int32)
+    eidx = tile_start[:, None] + slot[None, :]  # (T, TILE)
+    valid = slot[None, :] < tile_len[:, None]
+    eidx_safe = jnp.where(valid, eidx, 0)
+    edge_dst = dst[eidx_safe]
+    offs = jnp.where(valid, edge_dst - tile_block[:, None] * rows, -1).astype(
+        jnp.int32
+    )
+    cols = jnp.where(valid, col_idx[eidx_safe], 0).astype(jnp.int32)
+
+    push_thresh = pull_thresh = None
+    if fanout is not None:
+        def thresh(p):
+            # device twin of _bernoulli_threshold, in f32 (x64 is off):
+            # thresholds agree with the host's f64 values to ~2^-24 relative
+            # — a per-edge firing-probability perturbation of < 1e-7
+            return jnp.minimum(
+                jnp.ceil(jnp.clip(p, 0.0, 1.0) * jnp.float32(2.0**32)),
+                jnp.float32(2.0**32 - 1),
+            ).astype(jnp.uint32)
+
+        src_deg = jnp.where(valid, deg[col_idx[eidx_safe]], 0)
+        dst_deg = jnp.where(valid, deg[edge_dst], 0)
+        push_thresh = jnp.where(
+            valid & (src_deg > 0),
+            thresh(fanout / jnp.maximum(src_deg, 1).astype(jnp.float32)),
+            jnp.uint32(0),
+        ).reshape(T * 8, 128)
+        pull_thresh = jnp.where(
+            valid & (dst_deg > 0),
+            thresh(1.0 / jnp.maximum(dst_deg, 1).astype(jnp.float32)),
+            jnp.uint32(0),
+        ).reshape(T * 8, 128)
+
+    return (
+        tile_block,
+        first_visit,
+        offs.reshape(T * 8, 128),
+        cols.reshape(T * 8, 128),
+        push_thresh,
+        pull_thresh,
+    )
+
+
+def build_staircase_plan_device(
+    row_ptr: jax.Array,
+    col_idx: jax.Array,
+    fanout: int | None = None,
+    *,
+    rows: int = ROWS,
+) -> StaircasePlan:
+    """Device-side twin of :func:`build_staircase_plan`.
+
+    The host build moves the whole CSR device→host and the finished tables
+    host→device (~620 MB at 10M peers — ~90 s over a tunneled link); here
+    every table is computed where the CSR already lives and only ONE scalar
+    (the tile count, which sizes the static shapes) crosses to the host.
+    Routing tables match the host build exactly (parity-tested); Bernoulli
+    thresholds agree to f32 rounding (~2^-24 relative — the host computes
+    them in f64). int32 indices throughout — fine to ~2^31 edge slots.
+    """
+    if rows % 128 != 0 or rows <= 0:
+        raise ValueError(f"rows must be a positive multiple of 128, got {rows}")
+    row_ptr = jnp.asarray(row_ptr, dtype=jnp.int32)
+    col_idx = jnp.asarray(col_idx, dtype=jnp.int32)
+    n = int(row_ptr.shape[0]) - 1
+    n_blocks = max(1, math.ceil(n / rows))
+    tpb = _tiles_per_block(row_ptr, n, n_blocks, rows)
+    n_tiles = int(jnp.sum(tpb))  # the one host sync
+    tile_block, first_visit, offs, cols, push_thresh, pull_thresh = (
+        _plan_tables_device(
+            row_ptr, col_idx, tpb,
+            n=n, n_blocks=n_blocks, n_tiles=n_tiles, rows=rows, fanout=fanout,
+        )
+    )
+    return StaircasePlan(
+        tile_block=tile_block,
+        first_visit=first_visit,
+        offs=offs,
+        col_gather=cols,
+        n=n,
+        n_tiles=n_tiles,
         n_blocks=n_blocks,
         push_thresh=push_thresh,
         pull_thresh=pull_thresh,
