@@ -177,6 +177,7 @@ def bench_one(
     reps: int,
     plan=None,
     max_rounds: int = 500,
+    **cfg_kwargs,
 ):
     import jax
     import numpy as np
@@ -184,7 +185,10 @@ def bench_one(
     from tpu_gossip.core.state import SwarmConfig, init_swarm
     from tpu_gossip.sim.metrics import bench_swarm
 
-    cfg = SwarmConfig(n_peers=dg.n_pad, msg_slots=msg_slots, fanout=fanout, mode=mode)
+    cfg = SwarmConfig(
+        n_peers=dg.n_pad, msg_slots=msg_slots, fanout=fanout, mode=mode,
+        **cfg_kwargs,
+    )
     # one rumor per slot (distinct origins) so every slot carries traffic;
     # coverage/rounds-to-target are measured on slot 0 as always
     origins = np.arange(msg_slots)
@@ -298,6 +302,20 @@ def main(argv: list[str] | None = None) -> int:
         configs["flood_m16_xla"] = bench_one(dg1, "flood", 1, msg_slots=16, reps=reps)
         configs["flood_m16_pallas"] = bench_one(
             dg1, "flood", 1, msg_slots=16, reps=reps, plan=plan1_fl
+        )
+        # BASELINE config 4: 1M SIR epidemic (per-slot recovery 8 rounds
+        # after infection; coverage counts seen-ever, so the target stays
+        # reachable while recovered slots stop relaying — push_pull k1, whose
+        # anti-entropy wave outruns recovery; push k3 stalls ~98%)
+        configs["sir_1m_push_pull_m16"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=16, reps=reps, sir_recover_rounds=8
+        )
+        # BASELINE config 5: 1M dynamic Poisson churn with power-law
+        # re-wiring (rejoiners attach 2 fresh degree-preferential edges) —
+        # runs the XLA path by design: the kernel's edge tables are static
+        configs["churn_rewire_1m_push_pull_m16"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=16, reps=reps,
+            churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
         )
 
     if profile_dir:

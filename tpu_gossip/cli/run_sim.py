@@ -103,9 +103,13 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
+        # per-mode tuned block heights (bench.py _build_plan sweep):
+        # flood is tile-count-light and fastest at rows=128; sampled
+        # delivery amortizes better over 1024-row blocks
         plan = build_staircase_plan(
             graph.row_ptr, graph.col_idx,
             fanout=None if args.mode == "flood" else args.fanout,
+            rows=128 if args.mode == "flood" else 1024,
         )
 
     origins = rng.choice(args.peers, size=min(args.origins, args.peers), replace=False)

@@ -248,10 +248,14 @@ def _plan_tables_device(
         def thresh(p):
             # device twin of _bernoulli_threshold, in f32 (x64 is off):
             # thresholds agree with the host's f64 values to ~2^-24 relative
-            # — a per-edge firing-probability perturbation of < 1e-7
+            # — a per-edge firing-probability perturbation of < 1e-7. The
+            # clamp must be the largest f32 BELOW 2^32 (4294967040): f32
+            # can't represent 2^32-1, and converting an out-of-range float
+            # to uint32 is implementation-defined in XLA (saturates here,
+            # poison under an fptoui lowering elsewhere).
             return jnp.minimum(
                 jnp.ceil(jnp.clip(p, 0.0, 1.0) * jnp.float32(2.0**32)),
-                jnp.float32(2.0**32 - 1),
+                jnp.float32(4294967040.0),
             ).astype(jnp.uint32)
 
         src_deg = jnp.where(valid, deg[col_idx[eidx_safe]], 0)
