@@ -192,6 +192,37 @@ def test_sampled_multiword_subsampled_edges_agree_across_words():
     np.testing.assert_array_equal(got[:, 0], got[:, 40])
 
 
+def test_receptive_row_gating_and_billing():
+    """Row-level receptive gating: non-receptive rows receive nothing, an
+    all-true mask is identical to no mask (same key => same draws), and the
+    pull bill of masked rows is exactly the msgs difference."""
+    for m in (8, 48):  # single- and multi-word (bill rides group 0's launch)
+        g = next(iter(graphs()))
+        plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=2)
+        transmit = jnp.asarray(np.random.default_rng(11).random((g.n, m)) < 0.4)
+        key = jax.random.key(5)
+        inc_none, msgs_none = segment_sampled(
+            plan, transmit, None, m, key, do_push=True, do_pull=True
+        )
+        inc_all, msgs_all = segment_sampled(
+            plan, transmit, None, m, key,
+            receptive_rows=jnp.ones((g.n,), dtype=bool),
+            do_push=True, do_pull=True,
+        )
+        assert bool(jnp.array_equal(inc_none, inc_all))
+        assert int(msgs_none) == int(msgs_all)
+
+        rec = jnp.asarray(np.random.default_rng(12).random(g.n) < 0.5)
+        inc_p, msgs_p = segment_sampled(
+            plan, transmit, None, m, key, receptive_rows=rec,
+            do_push=True, do_pull=True,
+        )
+        assert not bool(jnp.any(inc_p[~rec]))  # masked rows get nothing
+        assert bool(jnp.array_equal(inc_p[rec], inc_all[rec]))
+        # masked pullers' requests+bits are exactly the billing difference
+        assert int(msgs_p) < int(msgs_all)
+
+
 def test_sampled_pull_requires_thresholds():
     g = next(iter(graphs()))
     plan = build_staircase_plan(g.row_ptr, g.col_idx)  # no fanout

@@ -346,21 +346,31 @@ def unpack_words(words: jax.Array, m: int) -> jax.Array:
     return ((words[:, None] >> jnp.arange(m, dtype=jnp.int32)[None, :]) & 1).astype(bool)
 
 
-def _kernel(m: int, rows: int):
-    def kernel(tb_ref, fv_ref, offs_ref, vals_ref, out_ref):
+def _kernel(m: int, rows: int, billed: bool):
+    """Staircase tile kernel. With ``billed``, a second per-edge int32 input
+    is appended to the bit planes as one extra contraction plane, so its
+    per-destination-row SUM rides the same MXU matmul — this is how pull
+    billing is segment-reduced without any random gather (the f32 sums are
+    exact: per-row per-round bill < 2^24 by orders of magnitude)."""
+
+    def kernel(tb_ref, fv_ref, offs_ref, vals_ref, *rest):
+        bill_ref, out_ref = rest if billed else (None, rest[0])
         t = pl.program_id(0)
         offs = offs_ref[:].reshape(1, TILE)  # (1, 1024)
         words = vals_ref[:].reshape(1, TILE)
-        bits = jnp.concatenate(
-            [(words >> s) & 1 for s in range(m)], axis=0
-        ).astype(jnp.float32)  # (m, 1024)
+        planes = [
+            ((words >> s) & 1).astype(jnp.float32) for s in range(m)
+        ]
+        if billed:
+            planes.append(bill_ref[:].reshape(1, TILE).astype(jnp.float32))
+        bits = jnp.concatenate(planes, axis=0)  # (m [+1], 1024)
         onehot = (
             jax.lax.broadcasted_iota(jnp.int32, (rows, TILE), 0) == offs
         ).astype(jnp.float32)  # (rows, 1024); offs=-1 matches nothing
         acc = jax.lax.dot_general(
             bits, onehot, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (m, rows)
+        )  # (m [+1], rows)
 
         @pl.when(fv_ref[t] == 1)
         def _():
@@ -374,31 +384,45 @@ def _kernel(m: int, rows: int):
 
 
 def _launch(
-    plan: StaircasePlan, vals: jax.Array, m: int, interpret: bool | None
-) -> jax.Array:
+    plan: StaircasePlan,
+    vals: jax.Array,
+    m: int,
+    interpret: bool | None,
+    bill: jax.Array | None = None,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run the staircase kernel over pre-gathered per-edge words
-    ``vals`` (T*8, 128) int32 → (N, m) bool segment-OR by destination row."""
+    ``vals`` (T*8, 128) int32 → (N, m) bool segment-OR by destination row.
+
+    With ``bill`` (per-edge int32 counts, same layout), also returns the
+    per-row segment-SUM of those counts as an (N,) f32 array — one extra
+    contraction plane, no extra launch."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     rows = plan.rows
+    billed = bill is not None
+    mm = m + 1 if billed else m
+    edge_spec = pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(plan.n_tiles,),
-        in_specs=[
-            pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0)),
-            pl.BlockSpec((8, 128), lambda t, tb, fv: (t, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, m, rows), lambda t, tb, fv: (tb[t], 0, 0)),
+        in_specs=[edge_spec] * (3 if billed else 2),
+        out_specs=pl.BlockSpec((1, mm, rows), lambda t, tb, fv: (tb[t], 0, 0)),
+    )
+    args = (plan.tile_block, plan.first_visit, plan.offs, vals) + (
+        (bill,) if billed else ()
     )
     out = pl.pallas_call(
-        _kernel(m, rows),
-        out_shape=jax.ShapeDtypeStruct((plan.n_blocks, m, rows), jnp.float32),
+        _kernel(m, rows, billed),
+        out_shape=jax.ShapeDtypeStruct((plan.n_blocks, mm, rows), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(plan.tile_block, plan.first_visit, plan.offs, vals)
-    # (NB, m, rows) -> (NB*rows, m) rows-major, trim padding rows
-    inc = out.transpose(0, 2, 1).reshape(plan.n_blocks * rows, m)
-    return inc[: plan.n] > 0.5
+    )(*args)
+    # (NB, mm, rows) -> (NB*rows, mm) rows-major, trim padding rows
+    flat = out.transpose(0, 2, 1).reshape(plan.n_blocks * rows, mm)
+    inc = flat[: plan.n, :m] > 0.5
+    if billed:
+        return inc, flat[: plan.n, m]
+    return inc
 
 
 @functools.partial(jax.jit, static_argnames=("m", "interpret"))
@@ -439,10 +463,16 @@ def segment_sampled(
     runs once. ``answer=None`` means the pull half answers with ``transmit``
     (the usual non-forward_once case) and skips the second pack+gather.
     ``receptive_rows`` (N,) bool gates the PULL half by the puller: a dead
-    or fully-removed peer asks nobody — matching the XLA path's
-    ``pull_ok`` gate. Returns ``(incoming (N, m) bool, msgs_sent scalar)``
-    where msgs counts delivered slot-bits per active edge plus one request
-    per active pull edge (the XLA path's accounting in expectation).
+    or fully-removed peer asks nobody — matching the XLA path's ``pull_ok``
+    gate. The gate is applied at ROW level (delivery mask on ``incoming``
+    plus a row mask on the kernel's segment-summed pull bill), never per
+    edge — callers that inspect raw ``incoming`` should note a
+    non-receptive row is fully zeroed, including push deliveries the XLA
+    path would leave for downstream masking; the engine's ``advance_round``
+    masks both identically. Returns ``(incoming (N, m) bool, msgs_sent
+    scalar)`` where msgs counts delivered slot-bits per active edge plus
+    one request per active pull edge of a receptive puller (the XLA path's
+    accounting in expectation).
 
     Sampling semantics are expected-``fanout`` Bernoulli per edge, not
     exactly-``fanout`` — identical to the dist engine's bucketed exchange
@@ -456,30 +486,26 @@ def segment_sampled(
     msgs = jnp.zeros((), jnp.int32)
     # edge-level activation is drawn ONCE and shared across all word groups:
     # an edge either fires this round or not, regardless of how many 32-slot
-    # words the bitmap spans
+    # words the bitmap spans. receptive gating is NOT applied per edge (that
+    # was a 6M-element random gather costing more than the rest of the round,
+    # ~76 ms of a 127 ms round at 1M peers): deliveries are row-masked after
+    # the kernel — equivalent, since the engine's advance_round applies the
+    # stricter per-slot receptive mask — and pull billing is segment-summed
+    # per puller row by an extra contraction plane, then masked by the same
+    # row predicate, so msgs accounting still matches the XLA path.
     active_p = active_q = None
+    pull_bill = None
     if do_push:
         active_p = jax.random.bits(k_push, shape, jnp.uint32) < plan.push_thresh
     if do_pull:
         active_q = jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_thresh
-        if receptive_rows is not None:
-            # per-edge puller mask via the plan's block structure: edge slot
-            # (tile t, local row offs) pulls for peer tile_block[t]*rows+offs,
-            # so a (T, rows) row-gather indexed by offs suffices — no full
-            # random gather
-            t8, _ = shape
-            t = t8 // 8
-            pad = plan.n_blocks * plan.rows - receptive_rows.shape[0]
-            rec = jnp.pad(receptive_rows, (0, pad)).reshape(plan.n_blocks, plan.rows)
-            rec_tiles = rec[plan.tile_block]  # (T, 128)
-            rec_edge = jnp.take_along_axis(
-                rec_tiles, jnp.maximum(plan.offs.reshape(t, 8 * 128), 0), axis=1
-            ).reshape(shape)
-            active_q = active_q & rec_edge
-        # one request per fired pull edge (edge-level, counted once)
-        msgs = msgs + jnp.sum(active_q, dtype=jnp.int32)
+        # one request per fired pull edge, billed to the puller (the edge's
+        # destination row); the pulled bits are added per group below
+        pull_bill = active_q.astype(jnp.int32)
+    groups = _slot_groups(m)
     outs = []
-    for lo, w in _slot_groups(m):
+    bill_row = None
+    for gi, (lo, w) in enumerate(groups):
         w_push = pack_words(transmit[:, lo : lo + w])[plan.col_gather]
         combined = jnp.zeros(shape, jnp.int32)
         if do_push:
@@ -493,7 +519,23 @@ def segment_sampled(
             )
             wq = jnp.where(active_q, w_ans, 0)
             combined = combined | wq
-            msgs = msgs + jnp.sum(jax.lax.population_count(wq), dtype=jnp.int32)
-        outs.append(_launch(plan, combined, w, interpret))
+            pull_bill = pull_bill + jax.lax.population_count(wq)
+        if do_pull and gi == len(groups) - 1:
+            # the bill is complete only after the LAST group's popcount, so
+            # it rides that group's launch (also lets XLA free each group's
+            # combined buffer before the next is built)
+            inc, bill_row = _launch(plan, combined, w, interpret, bill=pull_bill)
+        else:
+            inc = _launch(plan, combined, w, interpret)
+        outs.append(inc)
     incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    if receptive_rows is not None:
+        incoming = incoming & receptive_rows[:, None]
+    if do_pull:
+        # per-row f32 sums are exact (<< 2^24 per row); round to int before
+        # the global sum so the total stays exact past 2^24
+        billed = jnp.round(bill_row).astype(jnp.int32)
+        if receptive_rows is not None:
+            billed = jnp.where(receptive_rows, billed, 0)
+        msgs = msgs + jnp.sum(billed, dtype=jnp.int32)
     return incoming, msgs
