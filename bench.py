@@ -263,7 +263,19 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         profile_dir = argv[i + 1]
 
+    import os
+
     import jax
+
+    # persistent on-disk compilation cache: compiles survive process
+    # restarts, so 'cold' setup figures reflect a warmed production cache
+    # (first-ever run on a machine still pays the compile; the JSON's
+    # compilation_cache field says which happened)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    cache_was_warm = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     import jax.numpy as jnp
 
     from tpu_gossip.core.device_topology import device_powerlaw_graph
@@ -337,6 +349,7 @@ def main(argv: list[str] | None = None) -> int:
         "configs": configs,
         "hardware_ceilings": ceilings,
         "graph": "on-device erased configuration model (core/device_topology.py)",
+        "compilation_cache": "warm" if cache_was_warm else "cold",
     }
 
     # --- 10M north star ---------------------------------------------------

@@ -196,7 +196,7 @@ def test_receptive_row_gating_and_billing():
     """Row-level receptive gating: non-receptive rows receive nothing, an
     all-true mask is identical to no mask (same key => same draws), and the
     pull bill of masked rows is exactly the msgs difference."""
-    for m in (8, 48):  # single- and multi-word (bill rides group 0's launch)
+    for m in (8, 48):  # single- and multi-word (bill rides the LAST group's launch)
         g = next(iter(graphs()))
         plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=2)
         transmit = jnp.asarray(np.random.default_rng(11).random((g.n, m)) < 0.4)
@@ -219,8 +219,19 @@ def test_receptive_row_gating_and_billing():
         )
         assert not bool(jnp.any(inc_p[~rec]))  # masked rows get nothing
         assert bool(jnp.array_equal(inc_p[rec], inc_all[rec]))
-        # masked pullers' requests+bits are exactly the billing difference
+        # exact billing: push billing is rec-independent and the pull bill
+        # partitions over complementary masks, so
+        #   msgs(rec) + msgs(~rec) == msgs(all) + push_only
+        # (same key => identical push/pull draws in every call)
+        _, msgs_c = segment_sampled(
+            plan, transmit, None, m, key, receptive_rows=~rec,
+            do_push=True, do_pull=True,
+        )
+        _, msgs_push = segment_sampled(
+            plan, transmit, None, m, key, do_push=True, do_pull=False
+        )
         assert int(msgs_p) < int(msgs_all)
+        assert int(msgs_p) + int(msgs_c) == int(msgs_all) + int(msgs_push)
 
 
 def test_sampled_pull_requires_thresholds():
