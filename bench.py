@@ -272,7 +272,7 @@ def main(argv: list[str] | None = None) -> int:
     # (first-ever run on a machine still pays the compile; the JSON's
     # compilation_cache field says which happened)
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    cache_was_warm = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
+    cache_entries = len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
@@ -349,7 +349,12 @@ def main(argv: list[str] | None = None) -> int:
         "configs": configs,
         "hardware_ceilings": ceilings,
         "graph": "on-device erased configuration model (core/device_topology.py)",
-        "compilation_cache": "warm" if cache_was_warm else "cold",
+        # entry count + jax version, not a bald warm/cold claim: cache keys
+        # include the jaxlib version, so entries can be present yet stale
+        "compilation_cache": {
+            "entries_at_start": cache_entries,
+            "jax": jax.__version__,
+        },
     }
 
     # --- 10M north star ---------------------------------------------------

@@ -84,6 +84,23 @@ class StaircasePlan:
     rows: int = dataclasses.field(default=ROWS, metadata=dict(static=True))
 
 
+def _pad_tiles(t: int) -> int:
+    """Quantize a tile count up to ~0.8% granularity buckets.
+
+    ``n_tiles`` is a static jit/pallas-grid parameter, so every fresh graph
+    realization (new seed => slightly different tile count) would otherwise
+    recompile the plan builder and the kernel. Padding tiles are inert:
+    they revisit the last block with first_visit=0 and offs=-1, so the
+    one-hot matches nothing and they contribute exactly zero — at < 1% of
+    the grid (the bucket is size-relative, ~t/128), their cost is noise,
+    while same-sized graphs now share every compile (the persistent cache
+    makes this cross-process). Tiny grids quantize little and may still
+    recompile across seeds — they compile in well under a second anyway.
+    """
+    b = max(1, 1 << max(0, t.bit_length() - 7))
+    return -(-t // b) * b
+
+
 def _bernoulli_threshold(p: np.ndarray) -> np.ndarray:
     """P(u32 < thresh) == min(p, 1) up to 2^-32 (p=1 fires with probability
     1 - 2^-32 — one silent miss per ~4e9 edge draws, immaterial)."""
@@ -124,7 +141,12 @@ def build_staircase_plan(
     ends = row_ptr[np.minimum((np.arange(n_blocks) + 1) * rows, n)]
     spans = ends - starts
     tiles_per_block = np.maximum(1, np.ceil(spans / TILE).astype(np.int64))
-    T = int(tiles_per_block.sum())
+    # quantize the grid so same-sized graphs share compiles (_pad_tiles):
+    # the extra tiles ride the last block with zero valid edges — tile_len
+    # clips to 0, offs to -1, so they contribute nothing
+    t_real = int(tiles_per_block.sum())
+    T = _pad_tiles(t_real)
+    tiles_per_block[-1] += T - t_real
 
     tile_block = np.repeat(np.arange(n_blocks, dtype=np.int32), tiles_per_block)
     first_visit = np.ones(T, dtype=np.int32)
@@ -305,7 +327,13 @@ def build_staircase_plan_device(
     n = int(row_ptr.shape[0]) - 1
     n_blocks = max(1, math.ceil(n / rows))
     tpb = _tiles_per_block(row_ptr, n, n_blocks, rows)
-    n_tiles = int(jnp.sum(tpb))  # the one host sync
+    t_real = int(jnp.sum(tpb))  # the one host sync
+    # same grid quantization as the host build (_pad_tiles): padding tiles
+    # ride the last block with tile_len 0, so they are inert — and n_tiles
+    # stops varying per graph realization, which is what lets the jit
+    # below (and the kernel) hit the compilation cache across seeds
+    n_tiles = _pad_tiles(t_real)
+    tpb = tpb.at[-1].add(n_tiles - t_real)
     tile_block, first_visit, offs, cols, push_thresh, pull_thresh = (
         _plan_tables_device(
             row_ptr, col_idx, tpb,
