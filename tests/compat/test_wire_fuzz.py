@@ -1,0 +1,195 @@
+"""Adversarial wire-protocol fuzzing: network bytes are untrusted input.
+
+The reference calls bare ``pickle.loads`` and ``ast.literal_eval`` on
+socket bytes with no guard (reference Peer.py:103,194-199) — one malformed
+line kills a connection thread, and a crafted pickle executes arbitrary
+code. These tests pin the hardened contract: ``classify`` is total,
+``decode_subset`` never resolves a global, and a live peer survives
+garbage on the wire.
+"""
+
+import asyncio
+import io
+import pickle
+import pickletools
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tpu_gossip.compat import wire
+
+KINDS = {
+    "empty", "ping", "seed_handshake", "heartbeat", "dead_node",
+    "new_node_update", "gossip_or_text", "malformed",
+}
+
+PREFIXES = [
+    wire.SEED_HANDSHAKE_PREFIX, wire.HEARTBEAT_PREFIX, wire.DEAD_NODE_PREFIX,
+    wire.NEW_NODE_PREFIX, wire.PING,
+]
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.text())
+def test_classify_total_on_text(s):
+    kind, _ = wire.classify(s)
+    assert kind in KINDS
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary())
+def test_classify_total_on_bytes(b):
+    kind, _ = wire.classify(b)
+    assert kind in KINDS
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.sampled_from(PREFIXES), st.text())
+def test_classify_total_on_prefixed_garbage(prefix, tail):
+    """A recognized prefix with an arbitrary payload must classify (usually
+    as 'malformed'), never raise — this is the exact line shape that killed
+    the reference's reader thread (ast.literal_eval on garbage)."""
+    kind, _ = wire.classify(prefix + tail)
+    assert kind in KINDS
+
+
+addr_strategy = st.tuples(
+    st.text(
+        alphabet=st.characters(blacklist_categories=("Cs",)),  # no surrogates
+        max_size=40,
+    ),
+    st.integers(min_value=0, max_value=65535),
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(addr_strategy)
+def test_addr_codecs_roundtrip(addr):
+    """repr-escaping makes any codec-able ip string wire-safe (newlines and
+    quotes included) for every address-carrying message."""
+    assert wire.decode_peer_handshake(wire.encode_peer_handshake(addr).decode()) == addr
+    assert wire.decode_heartbeat(wire.encode_heartbeat(addr).decode()) == addr
+    assert wire.decode_dead_node(wire.encode_dead_node(addr).decode()) == addr
+    assert wire.decode_seed_handshake(wire.encode_seed_handshake(addr).decode()) == addr
+
+
+# NewNodeUpdate inherits the reference's '|'-separated framing
+# (Seed.py:203-206): ips containing '|' are not representable (hypothesis
+# found this; the decoder rejects such lines as malformed, it never
+# mis-parses) — so the roundtrip property holds on the '|'-free domain
+nnu_addr = st.tuples(
+    st.text(
+        alphabet=st.characters(blacklist_categories=("Cs",), blacklist_characters="|"),
+        max_size=40,
+    ),
+    st.integers(min_value=0, max_value=65535),
+)
+
+
+@settings(max_examples=100, deadline=None)
+@given(nnu_addr, st.lists(nnu_addr, max_size=5))
+def test_new_node_update_roundtrip(peer, subset):
+    got_peer, got_subset = wire.decode_new_node_update(
+        wire.encode_new_node_update(peer, subset).decode()
+    )
+    assert got_peer == peer and got_subset == subset
+
+
+def test_new_node_update_pipe_ip_is_malformed_not_misparsed():
+    line = wire.encode_new_node_update(("a|b", 1), [("x", 2)]).decode()
+    kind, _ = wire.classify(line)
+    assert kind == "malformed"
+
+
+def test_classify_malformed_regressions():
+    """Escapes found by review: non-list subsets (TypeError in the entry
+    comprehension) and garbage seed handshakes (SyntaxError from
+    literal_eval) must classify as malformed, not raise."""
+    for line in (
+        "NewNodeUpdate|('a', 1)|5",
+        "NewNodeUpdate|('a', 1)|[1, 2]",
+        "I am seed|((((",
+        "Heartbeat from {'a': 1}",
+    ):
+        kind, _ = wire.classify(line)
+        assert kind == "malformed", line
+    import pytest as _pytest
+
+    with _pytest.raises((ValueError, SyntaxError)):
+        wire.decode_seed_handshake("I am seed|((((")  # seed.py reconnect catches both
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary(max_size=200))
+def test_decode_subset_never_resolves_globals(payload):
+    """Arbitrary bytes either decode to an address list or raise — and the
+    restricted unpickler must never reach find_class's global lookup, which
+    it signals with its own UnpicklingError."""
+    try:
+        got = wire.decode_subset(payload)
+    except Exception:
+        pass  # malformed pickles may raise many things; none executed code
+    else:
+        assert isinstance(got, list)
+        assert all(isinstance(a, tuple) and len(a) == 2 for a in got)
+
+
+def test_decode_subset_blocks_code_execution():
+    """A classic RCE pickle (GLOBAL os.system + REDUCE) must be rejected at
+    find_class, before any call happens."""
+    evil = (
+        b"cos\nsystem\n"  # GLOBAL 'os system'
+        b"(S'echo pwned'\n"  # MARK, STRING
+        b"tR."  # TUPLE, REDUCE, STOP
+    )
+    pickletools.dis(io.BytesIO(evil))  # sanity: it IS a valid pickle program
+    with pytest.raises(pickle.UnpicklingError, match="forbidden global"):
+        wire.decode_subset(evil)
+
+
+def test_decode_subset_roundtrip_with_trailing_bytes():
+    subset = [("127.0.0.1", 5000), ("10.0.0.2", 121)]
+    payload = wire.encode_subset(subset) + b"Heartbeat from ('1.2.3.4', 5)\n"
+    assert wire.decode_subset(payload) == subset  # §2.6.9 trailing bytes
+
+
+def test_live_peer_survives_garbage_bytes(tmp_path):
+    """Socket-level: invalid UTF-8, a hostile heartbeat, and a deep literal
+    must not kill the reader — a valid heartbeat afterwards still lands."""
+    from tpu_gossip.compat.peer import PeerNode
+    from tpu_gossip.compat.timing import ProtocolTiming
+
+    import socket as socketlib
+
+    with socketlib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    async def run():
+        timing = ProtocolTiming(heartbeat_period=60, detect_period=60,
+                                heartbeat_timeout=120, gossip_period=60)
+        peer = PeerNode("127.0.0.1", port, timing=timing, log_dir=str(tmp_path))
+        await peer.start_detached()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"\xff\xfe garbage \xba\xad\n")
+        writer.write(b"Heartbeat from not-a-tuple)(\n")
+        writer.write(b"Heartbeat from " + b"(" * 200 + b"\n")
+        writer.write(b"Dead Node: {'a': object}\n")
+        writer.write(wire.encode_heartbeat(("9.9.9.9", 999)))
+        await writer.drain()
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            conns = list(peer.in_conns.values())
+            if any(c.identity == ("9.9.9.9", 999) for c in conns):
+                break
+        else:
+            raise AssertionError(
+                f"valid heartbeat never processed; conns="
+                f"{[c.identity for c in peer.in_conns.values()]}"
+            )
+        assert peer.running
+        writer.close()
+        await peer.stop()
+
+    asyncio.run(run())

@@ -213,7 +213,7 @@ class PeerNode:
                 break
             if not raw:
                 break
-            kind, payload = wire.classify(raw.decode())
+            kind, payload = wire.classify(raw)
             if kind == "heartbeat":
                 conn.identity = payload  # reported identity (Peer.py:194-199)
                 conn.last_hb = time.monotonic()
@@ -226,6 +226,8 @@ class PeerNode:
                         break
             elif kind == "gossip_or_text":
                 await self._on_gossip_line(payload, from_conn=conn)
+            elif kind == "malformed":
+                self.log(f"Malformed line: {payload!r}")
             elif kind == "empty":
                 continue
         (self.out_conns if outgoing else self.in_conns).pop(key, None)

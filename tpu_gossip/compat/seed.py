@@ -249,10 +249,12 @@ class SeedNode:
                 self._all_writers.append(writer)
                 writer.write(wire.encode_seed_handshake(self.addr))
                 await writer.drain()
-                line = (await reader.readline()).decode()
+                line = (await reader.readline()).decode(errors="replace")
                 try:
                     got = wire.decode_seed_handshake(line)
-                except ValueError:
+                except (ValueError, SyntaxError):
+                    # SyntaxError: literal_eval on a garbage reply — must not
+                    # kill the reconnect loop for the process lifetime
                     writer.close()
                     continue
                 self.seed_writers[got] = writer
@@ -276,7 +278,7 @@ class SeedNode:
         (Seed.py:240-299)."""
         self._all_writers.append(writer)
         try:
-            line = (await reader.readline()).decode()
+            line = (await reader.readline()).decode(errors="replace")
         except (ConnectionError, OSError):
             writer.close()
             return
@@ -340,7 +342,7 @@ class SeedNode:
                 break
             if not raw:
                 break
-            kind, payload = wire.classify(raw.decode())
+            kind, payload = wire.classify(raw)
             if kind == "heartbeat":
                 pass  # seeds don't track peer liveness timers; peers report deaths
             elif kind == "new_node_update":

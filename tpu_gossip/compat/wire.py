@@ -86,6 +86,10 @@ def decode_subset(payload: bytes) -> list[Addr]:
 # --- inter-seed topology replication (Seed.py:203-206 → 432-433) ------------
 
 def encode_new_node_update(new_peer: Addr, subset: list[Addr]) -> bytes:
+    """Known framing limitation (inherited from the reference's
+    '|'-separated format, Seed.py:203-206): an ip string containing '|'
+    is not representable — the decoder splits on the first '|' and will
+    reject such a line as malformed rather than mis-parse it."""
     return f"{NEW_NODE_PREFIX}{new_peer}|{list(subset)}\n".encode()
 
 
@@ -147,24 +151,42 @@ def gossip_message_id(line: str) -> str:
 
 # --- dispatch ---------------------------------------------------------------
 
-def classify(line: str) -> tuple[str, Any]:
-    """Map an inbound text line to (kind, decoded payload).
+def classify(line: str | bytes) -> tuple[str, Any]:
+    """Map an inbound line to (kind, decoded payload). TOTAL: never raises.
 
     Kinds: seed_handshake | heartbeat | ping | dead_node | new_node_update |
     gossip_or_text (everything else — the reference logs unknowns,
-    Peer.py:206,286, Seed.py:440-441).
+    Peer.py:206,286, Seed.py:440-441) | malformed (a recognized prefix whose
+    payload fails to parse) | empty.
+
+    Network bytes are untrusted, and the reader loops (compat/peer.py,
+    compat/seed.py) dispatch straight off this function: if it raised, one
+    malformed address (or non-UTF-8 bytes, accepted here via
+    ``errors="replace"``) would kill the connection's reader and leak the
+    socket — the reference has exactly that latent bug (its per-connection
+    thread dies in ``ast.literal_eval``, Peer.py:194-199). ``malformed``
+    lines are for logging, like unknown text.
     """
+    if isinstance(line, bytes):
+        line = line.decode(errors="replace")
     s = line.strip()
     if not s:
         return "empty", None
     if s == PING:
         return "ping", None
-    if s.startswith(SEED_HANDSHAKE_PREFIX):
-        return "seed_handshake", decode_seed_handshake(s)
-    if s.startswith(HEARTBEAT_PREFIX):
-        return "heartbeat", decode_heartbeat(s)
-    if s.startswith(DEAD_NODE_PREFIX):
-        return "dead_node", decode_dead_node(s)
-    if s.startswith(NEW_NODE_PREFIX):
-        return "new_node_update", decode_new_node_update(s)
+    try:
+        if s.startswith(SEED_HANDSHAKE_PREFIX):
+            return "seed_handshake", decode_seed_handshake(s)
+        if s.startswith(HEARTBEAT_PREFIX):
+            return "heartbeat", decode_heartbeat(s)
+        if s.startswith(DEAD_NODE_PREFIX):
+            return "dead_node", decode_dead_node(s)
+        if s.startswith(NEW_NODE_PREFIX):
+            return "new_node_update", decode_new_node_update(s)
+    except (ValueError, TypeError, SyntaxError, RecursionError, MemoryError):
+        # ValueError covers _parse_addr rejects; TypeError covers subset
+        # entries that aren't tuple-able (e.g. "NewNodeUpdate|('a',1)|5");
+        # SyntaxError/RecursionError/MemoryError cover ast.literal_eval on
+        # hostile payloads
+        return "malformed", s
     return "gossip_or_text", s

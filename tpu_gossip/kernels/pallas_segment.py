@@ -91,11 +91,12 @@ def _pad_tiles(t: int) -> int:
     realization (new seed => slightly different tile count) would otherwise
     recompile the plan builder and the kernel. Padding tiles are inert:
     they revisit the last block with first_visit=0 and offs=-1, so the
-    one-hot matches nothing and they contribute exactly zero — at < 1% of
-    the grid (the bucket is size-relative, ~t/128), their cost is noise,
-    while same-sized graphs now share every compile (the persistent cache
-    makes this cross-process). Tiny grids quantize little and may still
-    recompile across seeds — they compile in well under a second anyway.
+    one-hot matches nothing and they contribute exactly zero — at <= 1/64
+    (~1.6%) of the grid worst-case (the bucket is size-relative, between
+    t/128 and t/64 depending on where t sits in its octave), their cost is
+    noise, while same-sized graphs now share every compile (the persistent
+    cache makes this cross-process). Tiny grids quantize little and may
+    still recompile across seeds — they compile in under a second anyway.
     """
     b = max(1, 1 << max(0, t.bit_length() - 7))
     return -(-t // b) * b
