@@ -152,3 +152,51 @@ async def test_reference_conformant_one_hop(tmp_path):
                 assert not p.has_seen("one-hop")
     finally:
         await stop_all(seeds, peers)
+
+
+@asyncio_test
+async def test_seed_mesh_survives_hung_and_hostile_config_entries(tmp_path):
+    """A config.txt entry that accepts-and-never-replies (hung service) or
+    replies with garbage must cost one sweep iteration, not kill or stall
+    the reconnect loop: the two real seeds still form their mesh."""
+    config = tmp_path / "config.txt"
+    hung_port, garbage_port, s1, s2 = free_ports(4)
+
+    async def hung_handler(reader, writer):
+        await asyncio.sleep(30)  # accept, never reply
+
+    async def garbage_handler(reader, writer):
+        await reader.readline()
+        writer.write(b"I am seed|((((\n")
+        await writer.drain()
+
+    hung = await asyncio.start_server(hung_handler, "127.0.0.1", hung_port)
+    garbage = await asyncio.start_server(garbage_handler, "127.0.0.1", garbage_port)
+    # pre-seed the config with the two bad entries; real seeds self-append
+    config.write_text(f"127.0.0.1:{hung_port}\n127.0.0.1:{garbage_port}\n")
+
+    seeds = []
+    for p in (s1, s2):
+        s = SeedNode("127.0.0.1", p, str(config), timing=TIMING,
+                     log_dir=str(tmp_path), rng_seed=0)
+        await s.start()
+        seeds.append(s)
+    try:
+        # two sweeps: the first pays the bad-entry timeouts, the second must
+        # still run (loop alive) and link the real seeds
+        deadline = asyncio.get_event_loop().time() + 30 * TIMING.connect_timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if (seeds[1].addr in seeds[0].seed_writers
+                    or seeds[0].addr in seeds[1].seed_writers):
+                break
+            await asyncio.sleep(TIMING.seed_reconnect_period / 2)
+        else:
+            raise AssertionError(
+                f"seed mesh never formed past the bad entries: "
+                f"{[list(s.seed_writers) for s in seeds]}"
+            )
+    finally:
+        for s in seeds:
+            await s.stop()
+        hung.close()
+        garbage.close()

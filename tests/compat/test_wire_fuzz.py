@@ -114,25 +114,39 @@ def test_classify_malformed_regressions():
     ):
         kind, _ = wire.classify(line)
         assert kind == "malformed", line
-    import pytest as _pytest
-
-    with _pytest.raises((ValueError, SyntaxError)):
+    with pytest.raises((ValueError, SyntaxError)):
         wire.decode_seed_handshake("I am seed|((((")  # seed.py reconnect catches both
 
 
 @settings(max_examples=300, deadline=None)
 @given(st.binary(max_size=200))
 def test_decode_subset_never_resolves_globals(payload):
-    """Arbitrary bytes either decode to an address list or raise — and the
-    restricted unpickler must never reach find_class's global lookup, which
-    it signals with its own UnpicklingError."""
+    """Arbitrary bytes either decode to an address list or raise — and
+    whenever a payload reaches find_class (a GLOBAL/STACK_GLOBAL opcode),
+    the load must abort: no global is ever resolved into a value. Verified
+    with a spy, so a regression to permissive unpickling can't hide behind
+    an unrelated downstream exception."""
+    calls = []
+    orig = wire._SubsetUnpickler.find_class
+
+    def spy(self, module, name):
+        calls.append((module, name))
+        return orig(self, module, name)
+
+    wire._SubsetUnpickler.find_class = spy
     try:
-        got = wire.decode_subset(payload)
-    except Exception:
-        pass  # malformed pickles may raise many things; none executed code
-    else:
-        assert isinstance(got, list)
-        assert all(isinstance(a, tuple) and len(a) == 2 for a in got)
+        raised = False
+        try:
+            got = wire.decode_subset(payload)
+        except Exception:
+            raised = True  # malformed pickles may raise many things
+        else:
+            assert isinstance(got, list)
+            assert all(isinstance(a, tuple) and len(a) == 2 for a in got)
+        if calls:
+            assert raised, f"global lookup {calls} did not abort the load"
+    finally:
+        wire._SubsetUnpickler.find_class = orig
 
 
 def test_decode_subset_blocks_code_execution():

@@ -247,14 +247,24 @@ class SeedNode:
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     continue
                 self._all_writers.append(writer)
-                writer.write(wire.encode_seed_handshake(self.addr))
-                await writer.drain()
-                line = (await reader.readline()).decode(errors="replace")
+                # the whole handshake exchange is guarded + timed out: a peer
+                # that resets mid-handshake, or accepts and never replies,
+                # must cost one sweep iteration — not kill the reconnect loop
+                # for the process lifetime or stall the other seeds' retries
                 try:
+                    writer.write(wire.encode_seed_handshake(self.addr))
+                    await writer.drain()
+                    line = (
+                        await asyncio.wait_for(
+                            reader.readline(), timeout=self.timing.connect_timeout
+                        )
+                    ).decode(errors="replace")
                     got = wire.decode_seed_handshake(line)
-                except (ValueError, SyntaxError):
-                    # SyntaxError: literal_eval on a garbage reply — must not
-                    # kill the reconnect loop for the process lifetime
+                except (
+                    ConnectionError, OSError, asyncio.TimeoutError,
+                    # the literal_eval family, same set wire.classify guards
+                    ValueError, TypeError, SyntaxError, RecursionError, MemoryError,
+                ):
                     writer.close()
                     continue
                 self.seed_writers[got] = writer

@@ -67,7 +67,7 @@ def decode_seed_handshake(line: str) -> Addr:
 class _SubsetUnpickler(pickle.Unpickler):
     """Data-only unpickling: no global lookups at all."""
 
-    def find_class(self, module: str, name: str):  # pragma: no cover
+    def find_class(self, module: str, name: str):
         raise pickle.UnpicklingError(f"forbidden global {module}.{name}")
 
 
