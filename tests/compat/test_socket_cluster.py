@@ -161,14 +161,24 @@ async def test_seed_mesh_survives_hung_and_hostile_config_entries(tmp_path):
     the reconnect loop: the two real seeds still form their mesh."""
     config = tmp_path / "config.txt"
     hung_port, garbage_port, s1, s2 = free_ports(4)
+    hung_tasks = []
 
     async def hung_handler(reader, writer):
-        await asyncio.sleep(30)  # accept, never reply
+        hung_tasks.append(asyncio.current_task())
+        try:
+            await asyncio.sleep(30)  # accept, never reply (cancelled at teardown)
+        finally:
+            writer.close()
 
     async def garbage_handler(reader, writer):
-        await reader.readline()
-        writer.write(b"I am seed|((((\n")
-        await writer.drain()
+        # writer must be closed, else 3.12's Server.wait_closed() waits
+        # forever on the lingering connection
+        try:
+            await reader.readline()
+            writer.write(b"I am seed|((((\n")
+            await writer.drain()
+        finally:
+            writer.close()
 
     hung = await asyncio.start_server(hung_handler, "127.0.0.1", hung_port)
     garbage = await asyncio.start_server(garbage_handler, "127.0.0.1", garbage_port)
@@ -198,5 +208,12 @@ async def test_seed_mesh_survives_hung_and_hostile_config_entries(tmp_path):
     finally:
         for s in seeds:
             await s.stop()
+        for t in hung_tasks:
+            t.cancel()
         hung.close()
         garbage.close()
+        for srv in (hung, garbage):
+            try:
+                await asyncio.wait_for(srv.wait_closed(), timeout=5)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass  # teardown is best-effort; never hang the suite

@@ -135,8 +135,16 @@ class PeerNode:
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 self.log(f"Seed {seed_addr} unreachable")
                 continue
-            writer.write(wire.encode_peer_handshake(self.addr))
-            await writer.drain()
+            try:
+                writer.write(wire.encode_peer_handshake(self.addr))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                # a seed that resets mid-handshake must not abort bootstrap:
+                # the remaining quorum seeds still get contacted and gossip
+                # still starts (same guard as the seed-mesh handshake)
+                self.log(f"Seed {seed_addr} reset during handshake")
+                writer.close()
+                continue
             self.seed_writers[seed_addr] = writer
             self._tasks.append(
                 asyncio.ensure_future(self._seed_reply_loop(reader, seed_addr))
@@ -418,7 +426,12 @@ class PeerNode:
             w.close()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # best-effort shutdown: never hang on a straggler handler
+            # (3.12's wait_closed awaits every handler task)
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
 
     # --- introspection -----------------------------------------------------
 

@@ -246,11 +246,13 @@ class SeedNode:
                     )
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     continue
-                self._all_writers.append(writer)
                 # the whole handshake exchange is guarded + timed out: a peer
                 # that resets mid-handshake, or accepts and never replies,
                 # must cost one sweep iteration — not kill the reconnect loop
-                # for the process lifetime or stall the other seeds' retries
+                # for the process lifetime or stall the other seeds' retries.
+                # The writer joins _all_writers only on success: a bad config
+                # entry retried every sweep must not grow the cleanup list
+                # unboundedly.
                 try:
                     writer.write(wire.encode_seed_handshake(self.addr))
                     await writer.drain()
@@ -267,6 +269,10 @@ class SeedNode:
                 ):
                     writer.close()
                     continue
+                except asyncio.CancelledError:
+                    writer.close()  # stop() mid-handshake: don't leak the socket
+                    raise
+                self._all_writers.append(writer)
                 self.seed_writers[got] = writer
                 self.log(f"Connected to seed {got}")
                 t = asyncio.ensure_future(self._line_loop(reader, writer, got, is_seed=True))
@@ -394,7 +400,12 @@ class SeedNode:
             w.close()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # 3.12's wait_closed awaits every handler task; shutdown must be
+            # best-effort, never hang on a straggler mid-handshake
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
 
     def topology_snapshot(self) -> dict[Addr, set[Addr]]:
         return {k: set(v) for k, v in self.network_topology.items()}

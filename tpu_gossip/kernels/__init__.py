@@ -11,6 +11,11 @@ heartbeat/failure-detector state machine.
 from tpu_gossip.kernels.gossip import push_fanout, pull_fanout, flood_all
 from tpu_gossip.kernels.liveness import emit_heartbeats, detect_failures
 
+# NOTE: tpu_gossip.kernels.pallas_segment (StaircasePlan, plan builders,
+# segment_or/segment_sampled) is deliberately NOT re-exported here — every
+# consumer (sim/engine.py, cli/run_sim.py, bench.py) imports it lazily so
+# the jax.experimental.pallas/.tpu stack loads only when a plan is used,
+# and pure-XLA runs work even where that import can't.
 __all__ = [
     "push_fanout",
     "pull_fanout",
