@@ -25,6 +25,7 @@ import time
 from typing import Callable
 
 from tpu_gossip.compat import wire
+from tpu_gossip.compat.netutil import close_server_best_effort
 from tpu_gossip.compat.seed import load_config
 from tpu_gossip.compat.timing import ProtocolTiming
 from tpu_gossip.compat.wire import Addr
@@ -424,14 +425,7 @@ class PeerNode:
             conn.writer.close()
         for w in self.seed_writers.values():
             w.close()
-        if self._server is not None:
-            self._server.close()
-            # best-effort shutdown: never hang on a straggler handler
-            # (3.12's wait_closed awaits every handler task)
-            try:
-                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
-            except (asyncio.TimeoutError, TimeoutError):
-                pass
+        await close_server_best_effort(self._server)
 
     # --- introspection -----------------------------------------------------
 

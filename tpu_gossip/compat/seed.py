@@ -30,6 +30,7 @@ import random
 import zlib
 
 from tpu_gossip.compat import wire
+from tpu_gossip.compat.netutil import close_server_best_effort
 from tpu_gossip.compat.timing import ProtocolTiming
 from tpu_gossip.compat.wire import Addr
 
@@ -398,14 +399,7 @@ class SeedNode:
             t.cancel()
         for w in self._all_writers:
             w.close()
-        if self._server is not None:
-            self._server.close()
-            # 3.12's wait_closed awaits every handler task; shutdown must be
-            # best-effort, never hang on a straggler mid-handshake
-            try:
-                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
-            except (asyncio.TimeoutError, TimeoutError):
-                pass
+        await close_server_best_effort(self._server)
 
     def topology_snapshot(self) -> dict[Addr, set[Addr]]:
         return {k: set(v) for k, v in self.network_topology.items()}
