@@ -424,6 +424,22 @@ def main(argv: list[str] | None = None) -> int:
         plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
         ns_xla = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps)
         ns_pal = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps, plan=plan10)
+        # flood at north-star scale: the staircase kernel's strongest mode
+        # (its all-edges streaming formulation), one rep each path. The
+        # push_pull plan (~700 MB) is freed first: with it resident, XLA's
+        # ~1 GB flood intermediates spill and its round time inflates ~12x
+        # (observed 84 s/round vs 7 s isolated) — each path gets fair HBM.
+        del plan10
+        flood10_xla = bench_one(dg10, "flood", 1, msg_slots=16, reps=1, max_rounds=50)
+        plan10_fl, plan10_fl_s = _build_plan(dg10, fanout=None, rows=128, device=True)
+        flood10 = {
+            "xla": flood10_xla,
+            "pallas": bench_one(
+                dg10, "flood", 1, msg_slots=16, reps=1, max_rounds=50, plan=plan10_fl
+            ),
+            "plan_build_seconds": round(plan10_fl_s, 2),
+        }
+        del plan10_fl
         # end-to-end cost per path: each path is charged EVERYTHING it needs
         # beyond the warm graph build — the pallas path needs its staircase
         # plan, the xla path needs nothing extra — so 'met' can't hide a
@@ -443,6 +459,7 @@ def main(argv: list[str] | None = None) -> int:
             "+ path-specific prep + sim wall_seconds) < 60",
             "met_sim_only": bool(min(ns_xla["wall_seconds"], ns_pal["wall_seconds"]) < 60.0),
             "met": bool(min(e2e_xla, e2e_pal) < 60.0),
+            "flood_10m": flood10,
         }
 
     if with_dist:
