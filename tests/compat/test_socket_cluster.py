@@ -121,6 +121,55 @@ async def test_silent_peer_detected_and_purged(tmp_path):
 
 
 @asyncio_test
+async def test_simultaneous_failures_detected_in_one_sweep(tmp_path):
+    """The detector batches its PING grace: k simultaneously-silent peers
+    are declared dead in ONE grace period, not k (the reference serializes
+    the grace per stale peer, Peer.py:298-363 — a deliberate divergence).
+
+    Timing is chosen so the batched and serial behaviors are far apart:
+    grace is the dominant term, so 4 victims under a serial sweep need
+    ~sweep + 4*grace = ~2.45 s while the batched sweep finishes in
+    ~sweep + grace = ~0.95 s. The deadline sits between them."""
+    timing = ProtocolTiming(
+        heartbeat_period=0.1, detect_period=0.15, heartbeat_timeout=0.3,
+        ping_grace=0.5, gossip_period=10.0, seed_reconnect_period=10.0,
+        registration_settle=0.1, subset_apply_delay=0.1, connect_timeout=2.0,
+        topology_dump_period=60.0,
+    )
+    config = tmp_path / "config.txt"
+    config.write_text("")
+    ports = free_ports(5)
+    nodes = [
+        PeerNode("127.0.0.1", p, str(config), timing=timing, log_dir=str(tmp_path))
+        for p in ports
+    ]
+    observer, victims = nodes[0], nodes[1:]
+    for n in nodes:
+        await n.start_detached()
+    try:
+        await observer.connect_to([v.addr for v in victims])
+        assert len(observer.out_conns) == 4
+        await asyncio.sleep(timing.heartbeat_period * 1.5)  # heartbeats flow
+        for v in victims:
+            v.set_silent(True)
+        t0 = asyncio.get_event_loop().time()
+        # stale by t=timeout, swept within detect_period, ONE shared grace
+        deadline = t0 + timing.heartbeat_timeout + timing.detect_period \
+            + timing.ping_grace + 0.85
+        while asyncio.get_event_loop().time() < deadline:
+            if not observer.out_conns:
+                break
+            await asyncio.sleep(0.05)
+        assert not observer.out_conns, (
+            f"still connected after one batched sweep window: "
+            f"{list(observer.out_conns)}"
+        )
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@asyncio_test
 async def test_healthy_swarm_no_false_positives(tmp_path):
     seeds, peers = await start_cluster(tmp_path, n_seeds=2, n_peers=4)
     try:

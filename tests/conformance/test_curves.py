@@ -162,32 +162,40 @@ async def test_socket_vs_sim_curves_agree_1k(tmp_path):
 
     import pytest
 
-    # 1000 servers + ~2x3000 per-edge connections need ~8k descriptors
+    # 1000 servers + ~2x3000 per-edge connections need ~8k descriptors;
+    # restore the process-wide limit afterwards so it can't leak into
+    # later tests in this process
     soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
     want = 10_000
     hard_cap = want if hard == resource.RLIM_INFINITY else hard
+    raised = False
     if soft < want:
         if hard_cap < want:
             pytest.skip(f"needs ~{want} fds; RLIMIT_NOFILE hard cap is {hard}")
         try:
             resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+            raised = True
         except (ValueError, OSError):
             pytest.skip(f"needs ~{want} fds; RLIMIT_NOFILE is {soft}/{hard}")
-    graph = fixed_graph(1000)
-    origin = int(np.argmax(graph.degrees))
-    rounds = 20
+    try:
+        graph = fixed_graph(1000)
+        origin = int(np.argmax(graph.degrees))
+        rounds = 20
 
-    sock = await socket_curve(graph, origin, rounds, tmp_path)
-    sims = [sim_curve(graph, origin, rounds, seed=s) for s in range(3)]
+        sock = await socket_curve(graph, origin, rounds, tmp_path)
+        sims = [sim_curve(graph, origin, rounds, seed=s) for s in range(3)]
 
-    assert sock[-1] >= 0.99
-    assert all(c[-1] >= 0.99 for c in sims)
-    sim_r50 = np.median([rounds_to(c, 0.5) for c in sims])
-    sim_r99 = np.median([rounds_to(c, 0.99) for c in sims])
-    # tighter than the 40-peer test: at 1k the stochastic curves concentrate
-    # (observed exact agreement, 7/7 and 11/11)
-    assert abs(rounds_to(sock, 0.5) - sim_r50) <= 2
-    assert abs(rounds_to(sock, 0.99) - sim_r99) <= 3
+        assert sock[-1] >= 0.99
+        assert all(c[-1] >= 0.99 for c in sims)
+        sim_r50 = np.median([rounds_to(c, 0.5) for c in sims])
+        sim_r99 = np.median([rounds_to(c, 0.99) for c in sims])
+        # tighter than the 40-peer test: at 1k the stochastic curves
+        # concentrate (observed exact agreement, 7/7 and 11/11)
+        assert abs(rounds_to(sock, 0.5) - sim_r50) <= 2
+        assert abs(rounds_to(sock, 0.99) - sim_r99) <= 3
+    finally:
+        if raised:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
 
 
 def test_sim_curve_deterministic():

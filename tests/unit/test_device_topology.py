@@ -65,6 +65,56 @@ def test_parity_with_host_model():
     )
 
 
+@pytest.mark.parametrize("slack", [0.90, 0.98])
+def test_clip_tail_keeps_law_and_structure(slack):
+    """Force the stub budget below the sampled demand so the silent clip
+    path (core/device_topology.py _build: deg_eff = clip(total-start, 0,
+    deg)) actually fires, then assert the graph is still clean and the
+    degree law is only perturbed by O(1-slack).
+
+    Clipping zeroes the trailing ~(1-slack) fraction of nodes' stubs (the
+    cumsum boundary), so those rows become isolated — the tail exponent and
+    the surviving mean must stay within tolerance."""
+    key = jax.random.key(11)
+    clipped = device_powerlaw_graph(N, gamma=2.5, key=key, slack=slack)
+    full = device_powerlaw_graph(N, gamma=2.5, key=key)  # default slack 1.02
+
+    # the clip fired: even the POST-erasure realized total of the unclipped
+    # build exceeds the shrunken budget, so pre-erasure demand certainly did
+    tot_c = int(np.asarray(clipped.row_ptr)[N])
+    tot_f = int(np.asarray(full.row_ptr)[N])
+    d_max = max(3, int(round(N ** (1 / 1.5))))
+    mean = truncated_pareto_mean(2.5, 2, d_max)
+    s_cap = 2 * int(np.ceil(N * mean * slack / 2))
+    assert tot_f > s_cap, f"slack={slack} never constrained ({tot_f} <= {s_cap})"
+    assert tot_c < tot_f
+    assert tot_c <= s_cap  # budget is a hard cap
+    assert tot_c >= 0.90 * s_cap  # ... and erasure is the only other loss
+
+    # structure survives the clip: symmetric, no self-loops, no duplicates
+    g = clipped.to_host_graph()
+    rng = np.random.default_rng(0)
+    for i in rng.integers(0, N, 100):
+        nb = g.neighbors(int(i))
+        assert len(set(nb.tolist())) == len(nb)
+        assert int(i) not in nb
+        for j in nb[:3]:
+            assert int(i) in g.neighbors(int(j))
+
+    # the law survives: tail exponent within tolerance, surviving-node mean
+    # within the clip fraction of the full build's
+    deg = g.degrees
+    est = fit_powerlaw_gamma(deg, d_min=5)
+    assert abs(est - 2.5) < 0.35, f"gamma_hat={est} after clip"
+    zero_frac = float((deg == 0).mean())
+    assert zero_frac < 1.6 * (1.02 - slack) + 0.02, (
+        f"clip isolated {zero_frac:.1%} of nodes"
+    )
+    surviving_mean = float(deg[deg > 0].mean())
+    full_mean = float(full.to_host_graph().degrees.mean())
+    assert surviving_mean == pytest.approx(full_mean, rel=0.10)
+
+
 def test_deterministic_per_key():
     a = device_powerlaw_graph(2000, key=jax.random.key(5))
     b = device_powerlaw_graph(2000, key=jax.random.key(5))

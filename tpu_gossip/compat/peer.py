@@ -348,10 +348,18 @@ class PeerNode:
             await asyncio.sleep(self.timing.heartbeat_period)
 
     async def _detector_loop(self) -> None:
-        """Stale → PING → grace → declare dead (Peer.py:298-363)."""
+        """Stale → PING → grace → declare dead (Peer.py:298-363).
+
+        The sweep is batched: every stale connection is PINGed up front and
+        ONE grace period covers them all, so sweep time is O(1) in the stale
+        count. (The reference serializes the grace per stale peer —
+        Peer.py:298-363 — making k simultaneous failures take k grace
+        periods to clear; that is a bug band this build fixes on purpose,
+        like the rendezvous and re-broadcast quirks.)"""
         while self.running:
             await asyncio.sleep(self.timing.detect_period)
             now = time.monotonic()
+            suspects: list[tuple[Addr, _Conn, dict[Addr, _Conn]]] = []
             for conns in (self.out_conns, self.in_conns):
                 for key, conn in list(conns.items()):
                     if now - conn.last_hb <= self.timing.heartbeat_timeout:
@@ -362,10 +370,19 @@ class PeerNode:
                     except (ConnectionError, OSError):
                         await self._declare_dead(key, conn, conns)
                         continue
-                    await asyncio.sleep(self.timing.ping_grace)
-                    # a heartbeat during the grace advances last_hb (Peer.py:309)
-                    if time.monotonic() - conn.last_hb > self.timing.heartbeat_timeout:
-                        await self._declare_dead(key, conn, conns)
+                    suspects.append((key, conn, conns))
+            if not suspects:
+                continue
+            await asyncio.sleep(self.timing.ping_grace)
+            for key, conn, conns in suspects:
+                # the key may have been re-bound (reconnect) or removed
+                # (heartbeat-loop error path) during the shared grace — only
+                # the exact suspected connection may be declared dead
+                if conns.get(key) is not conn:
+                    continue
+                # a heartbeat during the grace advances last_hb (Peer.py:309)
+                if time.monotonic() - conn.last_hb > self.timing.heartbeat_timeout:
+                    await self._declare_dead(key, conn, conns)
 
     async def _declare_dead(self, key: Addr, conn: _Conn, conns: dict[Addr, _Conn]) -> None:
         identity = conn.identity or key

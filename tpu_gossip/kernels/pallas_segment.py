@@ -379,8 +379,10 @@ def _kernel(m: int, rows: int, billed: bool):
     """Staircase tile kernel. With ``billed``, a second per-edge int32 input
     is appended to the bit planes as one extra contraction plane, so its
     per-destination-row SUM rides the same MXU matmul — this is how pull
-    billing is segment-reduced without any random gather (the f32 sums are
-    exact: per-row per-round bill < 2^24 by orders of magnitude)."""
+    billing is segment-reduced without any random gather. The f32 sums are
+    exact while every row's bill stays < 2^24; see the bill-exactness note
+    on :func:`segment_sampled` for why the pull thresholds guarantee that
+    with probability 1 minus something astronomically small."""
 
     def kernel(tb_ref, fv_ref, offs_ref, vals_ref, *rest):
         bill_ref, out_ref = rest if billed else (None, rest[0])
@@ -507,9 +509,26 @@ def segment_sampled(
     exactly-``fanout`` — identical to the dist engine's bucketed exchange
     (dist/mesh.py), and statistically indistinguishable on coverage curves
     (tests/unit/test_pallas_segment.py bounds the discrepancy).
+
+    Bill exactness: the pull bill is segment-summed in f32 (one extra MXU
+    contraction plane), exact while every row's partial sum stays < 2^24.
+    The per-edge bill is at most ``1 + 32*ceil(m/32)``, and a row is billed
+    only for FIRED in-edges; the plan's pull thresholds are exactly
+    ``1/deg(dst)``, so a row's fired count is Binomial(deg, 1/deg) — mean 1
+    regardless of degree (hubs fire each edge proportionally less often).
+    Making the sum inexact therefore needs ``k = 2^24/(33*ceil(m/32))``
+    simultaneous fires of a mean-1 variable (k ~ 5*10^5 at m=16): tail
+    probability below (e/k)^k, i.e. zero for every physical ``m`` and
+    degree. This exactness argument leans on the 1/deg law — a future
+    builder wiring different pull thresholds must re-derive the bound
+    (m * max_in_degree enters deterministically there).
     """
     if plan.push_thresh is None:
         raise ValueError("plan built without fanout — no sampling thresholds")
+    if m > 2**18:
+        # keeps the documented bill-exactness tail bound meaningful
+        # (k >= 2^24/(33*ceil(m/32)) must stay astronomically improbable)
+        raise ValueError(f"msg_slots={m} out of the supported range (<= 2^18)")
     shape = plan.col_gather.shape
     k_push, k_pull = jax.random.split(key)
     msgs = jnp.zeros((), jnp.int32)
