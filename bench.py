@@ -1,7 +1,12 @@
 """Headline benchmark: power-law push/push-pull gossip to 99% coverage.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "peers_rounds_per_sec", "vs_baseline": N, ...}
+Prints ONE COMPACT JSON line (last on stdout, ≲1.5 KB so a tail capture
+can't truncate it):
+    {"metric": ..., "value": N, "unit": "peers_rounds_per_sec", "vs_baseline": N,
+     "configs_ms_per_round": {...}, "north_star": {...}, "dist": {...}}
+and writes the FULL result tree (per-config rounds/coverage/msgs, hardware
+ceilings, accounting notes) to ``BENCH_DETAIL.json`` next to this file —
+the committed, reviewable record.
 
 Metric per BASELINE.json: rounds-to-99%-coverage and peers·rounds/sec on a
 1M-node power-law (γ=2.5) swarm, plus the 10M-peer north-star run
@@ -42,9 +47,9 @@ are not self-referential. Per-config ``access_rate_per_sec_M`` uses the
 random-access ceiling as denominator: dissemination is bound by random
 gather/scatter access rate, not FLOPs (SURVEY.md §5.1 accounting).
 
-Flags: --quick (1M only, 1 rep) · --dist (add a sharded-engine run on the
-available device mesh) · --profile DIR (jax.profiler trace of one warmed
-headline run).
+Flags: --quick (1M only, 1 rep, skips the sharded-engine entry) · --dist
+(force the sharded-engine run even under --quick) · --profile DIR
+(jax.profiler trace of one warmed headline run).
 """
 
 from __future__ import annotations
@@ -267,11 +272,16 @@ def bench_liveness(n: int = 1000, silent_frac: float = 0.1, rounds: int = 20,
     }
 
 
-def bench_dist(n: int):
+def bench_dist(n: int, reps: int = 3):
     """Sharded-engine run over the available device mesh (1 real TPU chip
     here; 8 virtual CPU devices under the test env) — the multi-chip path's
     single-host measurement; cross-chip scaling is validated structurally by
-    __graft_entry__.dryrun_multichip."""
+    __graft_entry__.dryrun_multichip.
+
+    The LOCAL engine runs the identical relabeled topology from the same
+    initial state, so the ``overhead_vs_local`` ratio isolates what the
+    bucketed all_to_all exchange costs over the single-shard delivery path
+    on this mesh size (at mesh size 1 that is pure bucketing overhead)."""
     import numpy as np
 
     from tpu_gossip.core.state import SwarmConfig
@@ -280,24 +290,40 @@ def bench_dist(n: int):
         init_sharded_swarm, make_mesh, partition_graph,
         run_until_coverage_dist, shard_swarm,
     )
+    from tpu_gossip.sim.engine import run_until_coverage
 
     rng = np.random.default_rng(0)
     graph = build_csr(n, configuration_model(powerlaw_degree_sequence(n, gamma=2.5, rng=rng), rng=rng))
     mesh = make_mesh()
     sg, relabeled, position = partition_graph(graph, mesh.size, seed=0)
-    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=1, fanout=1, mode="push_pull")
-    st = shard_swarm(init_sharded_swarm(sg, relabeled, position, cfg, origins=[0]), mesh)
-    fin = run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300)
-    float(fin.coverage(0))  # warm
-    t0 = time.perf_counter()
-    fin = run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300)
-    cov = float(fin.coverage(0))
-    dt = time.perf_counter() - t0
-    rounds = int(fin.round)
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
+    st0 = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
+
+    def timed(run):
+        fin = run()  # warm (compile)
+        cov, rounds = float(fin.coverage(0)), int(fin.round)
+        best = float("inf")
+        for _ in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            fin = run()
+            float(fin.coverage(0))  # completion barrier
+            best = min(best, time.perf_counter() - t0)
+        return {
+            "rounds": rounds, "coverage": round(cov, 4),
+            "wall_seconds": round(best, 3),
+            "ms_per_round": round(best / max(rounds, 1) * 1000.0, 4),
+            "peers_rounds_per_sec": round(n * rounds / max(best, 1e-9), 1),
+        }
+
+    st = shard_swarm(st0, mesh)
+    dist = timed(lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300))
+    local = timed(lambda: run_until_coverage(st0, cfg, 0.99, 300))
     return {
-        "n_peers": n, "devices": mesh.size, "rounds": rounds,
-        "coverage": round(cov, 4), "wall_seconds": round(dt, 3),
-        "peers_rounds_per_sec": round(n * rounds / max(dt, 1e-9), 1),
+        "n_peers": n, "devices": mesh.size, "msg_slots": cfg.msg_slots,
+        "dist": dist, "local_same_graph": local,
+        "overhead_vs_local": round(
+            dist["ms_per_round"] / max(local["ms_per_round"], 1e-9), 3
+        ),
     }
 
 
@@ -372,12 +398,27 @@ def main(argv: list[str] | None = None) -> int:
         configs["sir_1m_push_pull_m16"] = bench_one(
             dg1, "push_pull", 1, msg_slots=16, reps=reps, sir_recover_rounds=8
         )
+        # same SIR config through the staircase kernel (per-slot recovered
+        # folds into transmit/receptive, so the sampled kernel covers
+        # BASELINE config 4 — measured, not just claimed)
+        configs["sir_1m_push_pull_m16_pallas"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=16, reps=reps, sir_recover_rounds=8,
+            plan=plan1_k1,
+        )
         # BASELINE config 5: 1M dynamic Poisson churn with power-law
-        # re-wiring (rejoiners attach 2 fresh degree-preferential edges) —
-        # runs the XLA path by design: the kernel's edge tables are static
-        configs["churn_rewire_1m_push_pull_m16"] = bench_one(
-            dg1, "push_pull", 1, msg_slots=16, reps=reps,
+        # re-wiring (rejoiners attach 2 fresh degree-preferential edges),
+        # on both delivery paths: the kernel carries the static-CSR bulk
+        # (rewired senders zeroed pre-pack, rewired receivers row-masked)
+        # while the sparse fresh-edge traffic rides the XLA side path
+        churn_kw = dict(
             churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+        )
+        configs["churn_rewire_1m_push_pull_m16"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=16, reps=reps, **churn_kw
+        )
+        configs["churn_rewire_1m_push_pull_m16_pallas"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1,
+            **churn_kw,
         )
         # BASELINE config 2: 1k peers + 3-miss liveness (detection latency
         # vs the reference's 30-42 s worst-case band, SURVEY.md §6)
@@ -421,8 +462,12 @@ def main(argv: list[str] | None = None) -> int:
         dg10 = device_powerlaw_graph(10_000_000, gamma=2.5, key=jax.random.key(1))
         int(dg10.row_ptr[-1])
         setup_warm = time.perf_counter() - t0
-        plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
+        # ns_xla runs BEFORE the ~700 MB staircase plan exists so the XLA
+        # baseline is measured with the HBM it would have in isolation (the
+        # same fairness the flood pair below gets by freeing the plan first;
+        # a resident plan inflates XLA round times via spill)
         ns_xla = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps)
+        plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
         ns_pal = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps, plan=plan10)
         # flood at north-star scale: the staircase kernel's strongest mode
         # (its all-edges streaming formulation), one rep each path. The
@@ -462,11 +507,69 @@ def main(argv: list[str] | None = None) -> int:
             "flood_10m": flood10,
         }
 
-    if with_dist:
-        out["dist"] = bench_dist(200_000)
+    if with_dist or not quick:
+        # sharded-engine overhead is part of the default artifact (VERDICT
+        # r3 item 5): mesh size 1 on the TPU chip = pure bucketing overhead
+        out["dist"] = bench_dist(200_000, reps=reps)
 
-    print(json.dumps(out))
+    # Full detail goes to a committed file; stdout's LAST line is a compact
+    # headline the driver's tail capture can always parse (the r3 artifact
+    # outgrew it: BENCH_r03.json "parsed": null). --quick smoke runs must
+    # not clobber a full run's committed record.
+    if not quick:
+        detail_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+        )
+        with open(detail_path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    compact = _compact(out)
+    if quick:
+        compact["detail_file"] = None  # quick runs don't write the record
+    print(json.dumps(compact), flush=True)
     return 0
+
+
+def _compact(out: dict) -> dict:
+    """The driver-facing headline: metric/value/vs_baseline plus one
+    ms_per_round figure per config — everything else lives in
+    BENCH_DETAIL.json. Kept well under ~1.5 KB so the driver's stdout tail
+    capture can never truncate it again."""
+    compact = {
+        k: out[k]
+        for k in (
+            "metric", "value", "unit", "vs_baseline", "rounds_to_99pct",
+            "wall_seconds", "headline_delivery",
+        )
+        if k in out
+    }
+    compact["configs_ms_per_round"] = {
+        k: v.get("ms_per_round") for k, v in out.get("configs", {}).items()
+    }
+    ns = out.get("north_star")
+    if ns:
+        compact["north_star"] = {
+            "met": ns["met"],
+            "met_sim_only": ns["met_sim_only"],
+            "best_delivery": ns["delivery"],
+            "end_to_end_seconds": {
+                p: ns[p]["end_to_end_seconds"] for p in ("xla", "pallas")
+            },
+            "ms_per_round": {p: ns[p]["ms_per_round"] for p in ("xla", "pallas")},
+            "flood_ms_per_round": {
+                p: ns["flood_10m"][p]["ms_per_round"] for p in ("xla", "pallas")
+            },
+        }
+    dist = out.get("dist")
+    if dist:
+        compact["dist"] = {
+            "devices": dist["devices"],
+            "ms_per_round": dist["dist"]["ms_per_round"],
+            "local_ms_per_round": dist["local_same_graph"]["ms_per_round"],
+            "overhead_vs_local": dist["overhead_vs_local"],
+        }
+    compact["detail_file"] = "BENCH_DETAIL.json"
+    return compact
 
 
 if __name__ == "__main__":

@@ -52,8 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--staircase",
         action="store_true",
         help="deliver via the Pallas staircase kernel: exact segment-OR for "
-        "flood, Bernoulli-per-edge sampling for push/push_pull (needs "
-        "--rewire-slots 0; any --slots width, one launch per 32 slots)",
+        "flood, Bernoulli-per-edge sampling for push/push_pull (any --slots "
+        "width, one launch per 32 slots). Composes with --rewire-slots in "
+        "push/push_pull: the static CSR rides the kernel, rejoiners' fresh "
+        "edges go through the XLA side path. Flood ignores re-wiring on "
+        "every delivery path (the flood is defined over the static CSR)",
     )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
@@ -96,11 +99,6 @@ def main(argv: list[str] | None = None) -> int:
     )
     plan = None
     if args.staircase:
-        if args.rewire_slots > 0 and args.mode != "flood":
-            print("--staircase sampling uses static edge tables: not compatible "
-                  "with --rewire-slots (churn re-wiring runs the XLA path)",
-                  file=sys.stderr)
-            return 2
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
         # per-mode tuned block heights (bench.py _build_plan sweep):

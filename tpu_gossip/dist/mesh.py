@@ -39,12 +39,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm
 from tpu_gossip.core.topology import Graph, build_csr
-from tpu_gossip.kernels.gossip import pull_fanout, push_fanout
 from tpu_gossip.sim.engine import (
     RoundStats,
     advance_round,
     compute_roles,
-    reverse_fresh_push,
+    fresh_rewire_traffic,
     transmit_bitmap,
     validate_rewire_width,
 )
@@ -271,61 +270,6 @@ def _exchange(
     )
 
 
-def _fresh_rewire_traffic(
-    state: SwarmState,
-    cfg: SwarmConfig,
-    transmit: jax.Array,
-    answer: jax.Array,
-    receptive_any: jax.Array,
-    k_push: jax.Array,
-    k_pull: jax.Array,
-    do_pull: bool,
-) -> tuple[jax.Array, jax.Array]:
-    """Dissemination over rejoined peers' fresh degree-preferential edges.
-
-    The bucket tables are static per graph, so a rejoiner's fresh edges
-    can't ride the all_to_all; they go through GLOBAL-VIEW gather/scatter
-    instead — outside shard_map, so XLA's SPMD partitioner inserts the
-    collectives. Rewire traffic is sparse (only rejoined slots fire), and
-    the semantics mirror the local engine's ``_substitute_rewired`` exactly:
-    push fans out to ``fanout`` draws from the fresh targets, pull asks one,
-    and the bidirectional reverse pass delivers the targets' pushes back to
-    the rejoiner (sim.engine.reverse_fresh_push). Fresh-target -1 entries
-    (sentinel draws) stay invalid.
-    """
-    incoming = jnp.zeros_like(transmit)
-    msgs = jnp.zeros((), dtype=jnp.int32)
-    n = state.rewired.shape[0]
-    k_push, k_rev = jax.random.split(k_push)
-
-    def draw(key, width):
-        soff = jax.random.randint(key, (n, width), 0, cfg.rewire_slots)
-        stgt = jnp.take_along_axis(
-            state.rewire_targets[:, : cfg.rewire_slots], soff, axis=1
-        )
-        return jnp.maximum(stgt, 0), state.rewired[:, None] & (stgt >= 0)
-
-    tgt, valid = draw(k_push, cfg.fanout)
-    push_valid = valid & transmit.any(-1)[:, None]
-    incoming = incoming | push_fanout(transmit, tgt, push_valid)
-    msgs = msgs + jnp.sum(
-        transmit.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
-    )
-    rev, rev_msgs = reverse_fresh_push(state, cfg, transmit, k_rev)
-    incoming = incoming | rev
-    msgs = msgs + rev_msgs
-    if do_pull:
-        ptgt, pvalid = draw(k_pull, 1)
-        # a dead / fully-removed rewired slot asks nobody (the local
-        # engine's pull_ok gate)
-        pvalid = pvalid & receptive_any[:, None]
-        incoming = incoming | pull_fanout(answer, ptgt, pvalid)
-        msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
-            answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pvalid[:, 0]
-        )
-    return incoming, msgs
-
-
 def gossip_round_dist(
     state: SwarmState, cfg: SwarmConfig, sg: ShardedGraph, mesh: Mesh
 ) -> tuple[SwarmState, RoundStats]:
@@ -336,7 +280,9 @@ def gossip_round_dist(
     edges — a rewired sender's CSR out-edges carry nothing, and nothing
     arrives at a rewired slot over CSR edges — and the rejoiners' fresh
     degree-preferential edges carry their traffic via
-    :func:`_fresh_rewire_traffic`. Flood mode ignores re-wiring (both
+    :func:`~tpu_gossip.sim.engine.fresh_rewire_traffic` (outside shard_map —
+    XLA's SPMD partitioner inserts the collectives). Flood mode ignores
+    re-wiring (both
     engines: the flood is defined over the static CSR)."""
     if sg.n_shards != mesh.size:
         raise ValueError(
@@ -377,7 +323,7 @@ def gossip_round_dist(
         incoming = incoming | inc
         # delivered bits + one request per pulling peer, mirroring the local
         # engine's accounting (sim/engine.py _disseminate_local); rewired
-        # pullers are billed in _fresh_rewire_traffic instead, not twice
+        # pullers are billed in fresh_rewire_traffic instead, not twice
         pulls = (sg.deg > 0) & receptive.any(-1)
         if rewiring:
             pulls = pulls & ~state.rewired
@@ -391,7 +337,7 @@ def gossip_round_dist(
         msgs_sent = msgs_sent + jnp.sum(msgs)
 
     if rewiring:
-        inc, msgs = _fresh_rewire_traffic(
+        inc, msgs = fresh_rewire_traffic(
             state, cfg, transmit, answer, receptive.any(-1), k_rw_push, k_rw_pull,
             do_pull=(cfg.mode == "push_pull"),
         )

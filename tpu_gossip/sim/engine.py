@@ -42,6 +42,7 @@ __all__ = [
     "transmit_bitmap",
     "validate_rewire_width",
     "reverse_fresh_push",
+    "fresh_rewire_traffic",
     "advance_round",
     "gossip_round",
     "simulate",
@@ -115,8 +116,17 @@ def _disseminate_local(
     scatter/segment reduction: flood always, push/push_pull when the plan
     carries sampling thresholds (built with ``fanout``). Sampled-kernel
     rounds use Bernoulli-per-edge activation (the dist engine's semantics)
-    rather than exactly-k; churn re-wiring keeps the XLA path (the kernel's
-    edge tables are static)."""
+    rather than exactly-k. With churn re-wiring (``cfg.rewire_slots > 0``)
+    the static-CSR bulk still rides the kernel — rewired senders' words are
+    zeroed before packing, rewired receivers are row-masked after (their
+    static in-edges are the departed occupant's) — and only the rejoiners'
+    sparse fresh-edge traffic goes through the XLA side path
+    (:func:`fresh_rewire_traffic`), exactly the dist engine's decomposition
+    (dist/mesh.py gossip_round_dist). Billing on that path follows the
+    kernel's sender-side convention: a fired CSR edge into a rewired slot is
+    billed though its delivery is dropped (the XLA path filters stale edges
+    before counting) — an O(rewired-fraction) expected-value divergence,
+    same as the dist engine's per-puller request billing."""
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     incoming = jnp.zeros_like(state.seen)
     k_push, k_rw_push = jax.random.split(k_push)
@@ -125,7 +135,6 @@ def _disseminate_local(
         plan is not None
         and getattr(plan, "push_thresh", None) is not None
         and cfg.mode in ("push", "push_pull")
-        and cfg.rewire_slots == 0
     )
     if sampled_kernel:
         from tpu_gossip.kernels.pallas_segment import segment_sampled
@@ -137,11 +146,26 @@ def _disseminate_local(
         # pull ships the responder's full seen set (forward_once budgets
         # gate pushing, never answering) — None = same array as transmit
         answer = (state.seen & transmitter) if cfg.forward_once else None
-        return segment_sampled(
-            plan, transmit, answer, cfg.msg_slots, k_push,
-            receptive_rows=receptive.any(-1),
+        tx, rec_rows = transmit, receptive.any(-1)
+        if cfg.rewire_slots > 0:
+            tx = tx & ~state.rewired[:, None]
+            if answer is not None:
+                answer = answer & ~state.rewired[:, None]
+            rec_rows = rec_rows & ~state.rewired
+        incoming, msgs_sent = segment_sampled(
+            plan, tx, answer, cfg.msg_slots, k_push,
+            receptive_rows=rec_rows,
             do_push=True, do_pull=(cfg.mode == "push_pull"),
         )
+        if cfg.rewire_slots > 0:
+            fresh_inc, fresh_msgs = fresh_rewire_traffic(
+                state, cfg, transmit, state.seen & transmitter,
+                receptive.any(-1), k_rw_push, k_rw_pull,
+                do_pull=(cfg.mode == "push_pull"),
+            )
+            incoming = incoming | fresh_inc
+            msgs_sent = msgs_sent + fresh_msgs
+        return incoming, msgs_sent
     if cfg.mode in ("push", "push_pull"):
         tgt, valid = sample_fanout_targets(
             k_push, state.row_ptr, state.col_idx, cfg.fanout
@@ -222,6 +246,62 @@ def reverse_fresh_push(
         transmit[tgt].sum(-1, dtype=jnp.int32) * fire.astype(jnp.int32)
     )
     return got.any(axis=1), msgs
+
+
+def fresh_rewire_traffic(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    transmit: jax.Array,
+    answer: jax.Array,
+    receptive_any: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+    do_pull: bool,
+) -> tuple[jax.Array, jax.Array]:
+    """Dissemination over rejoined peers' fresh degree-preferential edges.
+
+    Static edge tables (the dist engine's bucket tables, the staircase
+    kernel's tile plans) can't carry a rejoiner's fresh edges, so this
+    traffic goes through global-view gather/scatter instead — sparse (only
+    rejoined slots fire), and the semantics mirror the local XLA path's
+    ``_substitute_rewired`` exactly: push fans out to ``fanout`` draws from
+    the fresh targets, pull asks one, and the bidirectional reverse pass
+    delivers the targets' pushes back to the rejoiner
+    (:func:`reverse_fresh_push`). Fresh-target -1 entries (sentinel draws)
+    stay invalid. Shared by the dist engine (dist/mesh.py, where XLA's SPMD
+    partitioner inserts the collectives) and the local kernel path.
+    """
+    incoming = jnp.zeros_like(transmit)
+    msgs = jnp.zeros((), dtype=jnp.int32)
+    n = state.rewired.shape[0]
+    k_push, k_rev = jax.random.split(k_push)
+
+    def draw(key, width):
+        soff = jax.random.randint(key, (n, width), 0, cfg.rewire_slots)
+        stgt = jnp.take_along_axis(
+            state.rewire_targets[:, : cfg.rewire_slots], soff, axis=1
+        )
+        return jnp.maximum(stgt, 0), state.rewired[:, None] & (stgt >= 0)
+
+    tgt, valid = draw(k_push, cfg.fanout)
+    push_valid = valid & transmit.any(-1)[:, None]
+    incoming = incoming | push_fanout(transmit, tgt, push_valid)
+    msgs = msgs + jnp.sum(
+        transmit.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
+    )
+    rev, rev_msgs = reverse_fresh_push(state, cfg, transmit, k_rev)
+    incoming = incoming | rev
+    msgs = msgs + rev_msgs
+    if do_pull:
+        ptgt, pvalid = draw(k_pull, 1)
+        # a dead / fully-removed rewired slot asks nobody (the local
+        # engine's pull_ok gate)
+        pvalid = pvalid & receptive_any[:, None]
+        incoming = incoming | pull_fanout(answer, ptgt, pvalid)
+        msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
+            answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pvalid[:, 0]
+        )
+    return incoming, msgs
 
 
 def _substitute_rewired(
