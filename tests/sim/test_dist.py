@@ -7,6 +7,7 @@ import pytest
 
 from tpu_gossip import SwarmConfig, build_csr, preferential_attachment
 from tpu_gossip.dist import (
+    build_shard_plans,
     init_sharded_swarm,
     make_mesh,
     partition_graph,
@@ -221,11 +222,71 @@ def test_dist_local_curve_parity(setup, mode, fanout):
         assert abs(loc - dst) <= 2.0, (mode, target, loc, dst)
 
 
-def test_sharding_layout(setup):
-    """State stays peer-sharded across rounds (no silent full replication)."""
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("flood", {}),
+        ("push", {}),
+        ("push_pull", {}),
+        ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
+                           rewire_slots=2)),
+    ],
+    ids=["flood", "push", "push_pull", "push_pull_churn"],
+)
+def test_kernel_receive_path_bit_parity(setup, mode, extra):
+    """The fused staircase kernel (VERDICT r3 item 1): replacing the
+    receive-side ``.at[].max`` scatter with the per-shard staircase kernel
+    changes NOTHING upstream — activation draws, all_to_all, stale filters
+    and billing are shared — so the full state trajectory must be
+    bit-identical, every mode, churn re-wiring included. (Transitively this
+    also gives flood bit-parity with the single-device engine via
+    test_flood_parity_with_single_device.)"""
     _, mesh, sg, relabeled, position = setup
-    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=4, mode="push")
+    plans = build_shard_plans(sg)
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2, mode=mode, **extra)
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0, 1],
+                           key=jax.random.key(3)), mesh)
+    fin_a, stats_a = simulate_dist(st, cfg, sg, mesh, 6)
+    fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 6, plans)
+    np.testing.assert_array_equal(np.asarray(fin_a.seen), np.asarray(fin_b.seen))
+    np.testing.assert_array_equal(
+        np.asarray(stats_a.msgs_sent), np.asarray(stats_b.msgs_sent)
+    )
+    for f in ("alive", "rewired", "declared_dead", "recovered", "last_hb"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fin_a, f)), np.asarray(getattr(fin_b, f)), err_msg=f
+        )
+
+
+@pytest.mark.parametrize(
+    "mode,extra,kernel",
+    [
+        ("push", {}, False),
+        ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
+                           rewire_slots=2), False),
+        ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
+                           rewire_slots=2), True),
+    ],
+    ids=["push", "push_pull_churn", "push_pull_churn_kernel"],
+)
+def test_sharding_layout(setup, mode, extra, kernel):
+    """EVERY peer-axis state leaf stays peer-sharded across rounds — no
+    silent full replication. The churn configs guard the re-wiring path
+    (VERDICT r3 item 6): fresh_rewire_traffic runs global-view
+    gather/scatter OUTSIDE shard_map, trusting the SPMD partitioner — a
+    partitioner decision to all-gather the (N, M) arrays there would be
+    invisible to a plain-push-only check."""
+    _, mesh, sg, relabeled, position = setup
+    plans = build_shard_plans(sg) if kernel else None
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=4, mode=mode, fanout=2, **extra)
     st = shard_swarm(init_sharded_swarm(sg, relabeled, position, cfg, origins=[0]), mesh)
-    fin, _ = simulate_dist(st, cfg, sg, mesh, 2)
-    shardings = {str(fin.seen.sharding.spec), str(fin.alive.sharding.spec)}
-    assert all("peers" in s for s in shardings), shardings
+    fin, _ = simulate_dist(st, cfg, sg, mesh, 3, plans)
+    bad = {}
+    for f in type(fin).__dataclass_fields__:
+        v = getattr(fin, f)
+        if hasattr(v, "ndim") and v.ndim >= 1 and v.shape[0] == sg.n_pad:
+            spec = str(v.sharding.spec)
+            if "peers" not in spec:
+                bad[f] = spec
+    assert not bad, f"state leaves lost the peer sharding: {bad}"

@@ -9,8 +9,10 @@ fan-out is one ``all_to_all`` inside ``shard_map``.
 
 from tpu_gossip.dist.mesh import (
     ShardedGraph,
+    ShardPlans,
     make_mesh,
     partition_graph,
+    build_shard_plans,
     shard_swarm,
     gossip_round_dist,
     simulate_dist,
@@ -20,8 +22,10 @@ from tpu_gossip.dist.mesh import (
 
 __all__ = [
     "ShardedGraph",
+    "ShardPlans",
     "make_mesh",
     "partition_graph",
+    "build_shard_plans",
     "shard_swarm",
     "init_sharded_swarm",
     "gossip_round_dist",

@@ -14,8 +14,13 @@ fan-out: power-law hubs make per-shard edge counts wildly unbalanced, and
    transmit bits along its out-edges, applies per-edge activation (Bernoulli
    k/deg for push — the static-shape equivalent of sampling k neighbors —
    1/deg(dst) for pull, all-on for flood), and one ``lax.all_to_all`` over
-   the mesh routes every bucket to its destination shard, which scatter-ORs
-   into its local ``incoming``. ICI carries the buckets; no host round-trips.
+   the mesh routes every bucket to its destination shard, which merges it
+   into its local ``incoming`` — via a scatter-OR, or, with
+   :func:`build_shard_plans`, via the staircase Pallas kernel run per shard
+   over the received buckets (the north star's "single Pallas
+   segment-scatter kernel … peers 1-D sharded across the TPU mesh",
+   bit-identical to the scatter). ICI carries the buckets; no host
+   round-trips.
 
 Everything after dissemination (dedup merge, SIR, liveness, churn) reuses
 ``sim.engine.advance_round`` — elementwise over the peer axis, so XLA keeps
@@ -50,8 +55,10 @@ from tpu_gossip.sim.engine import (
 
 __all__ = [
     "ShardedGraph",
+    "ShardPlans",
     "make_mesh",
     "partition_graph",
+    "build_shard_plans",
     "shard_swarm",
     "init_sharded_swarm",
     "gossip_round_dist",
@@ -159,6 +166,104 @@ def partition_graph(
     return sg, relabeled, position
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardPlans:
+    """Per-shard staircase plans for kernel-side delivery in the dist engine
+    (the north star's fusion: "a single Pallas segment-scatter kernel …
+    peers 1-D sharded across the TPU mesh").
+
+    One :class:`~tpu_gossip.kernels.pallas_segment.StaircasePlan` per
+    destination shard, stacked on a leading shard axis so ``shard_map`` can
+    hand each device its own routing tables. All shards share one static
+    tile count (``n_tiles``) — SPMD programs need identical shapes — with
+    inert padding tiles absorbing the imbalance. ``entry_gather`` indexes
+    the shard's flattened ``all_to_all`` result (the (S*B,) received-word
+    vector), playing the role ``col_gather`` plays against a CSR.
+    """
+
+    tile_block: jax.Array  # int32 (S, T)
+    first_visit: jax.Array  # int32 (S, T)
+    offs: jax.Array  # int32 (S, T*8, 128)
+    entry_gather: jax.Array  # int32 (S, T*8, 128)
+    per: int = dataclasses.field(metadata=dict(static=True))
+    n_tiles: int = dataclasses.field(metadata=dict(static=True))
+    n_blocks: int = dataclasses.field(metadata=dict(static=True))
+    rows: int = dataclasses.field(default=128, metadata=dict(static=True))
+    # provenance of the bucket layout the tables index — checked against the
+    # ShardedGraph at exchange time (a mismatched plan gathers out-of-order
+    # received words and XLA's clamping gather would make it silently wrong)
+    n_shards: int = dataclasses.field(default=0, metadata=dict(static=True))
+    bucket: int = dataclasses.field(default=0, metadata=dict(static=True))
+
+    def check_matches(self, sg: "ShardedGraph") -> None:
+        got = (self.per, self.n_shards, self.bucket)
+        want = (sg.per_shard, sg.n_shards, sg.bucket)
+        if got != want:
+            raise ValueError(
+                f"shard_plan built for (per, shards, bucket)={got} but the "
+                f"graph has {want} — rebuild with build_shard_plans(sg)"
+            )
+
+
+def build_shard_plans(sg: ShardedGraph, *, rows: int = 128) -> ShardPlans:
+    """Staircase plans over each shard's RECEIVE side of the bucket tables.
+
+    The dist engine's receive-side scatter (``.at[recv_dst].max`` over the
+    all_to_all result) is the same serialized segment reduction the local
+    staircase kernel replaces (reference Peer.py:395-408) — so build, per
+    destination shard, a staircase plan whose "edges" are the shard's valid
+    bucket entries sorted by receiver-local row. Sorting is what the CSR
+    gave the local plan for free; ``entry_gather`` carries the sort so the
+    kernel gathers packed received words in destination order. Host-side,
+    once per partitioned graph, like ``partition_graph`` itself.
+    """
+    from tpu_gossip.kernels.pallas_segment import (
+        TILE, _pad_tiles, build_staircase_plan,
+    )
+
+    s, b, per = sg.n_shards, sg.bucket, sg.per_shard
+    recv_dst = np.asarray(sg.recv_dst)  # (S_dst, S_src, B)
+    # valid viewed from the receiver: send_valid is (src, dst, b)
+    recv_valid = np.asarray(sg.send_valid).transpose(1, 0, 2)
+
+    per_shard_csr = []
+    t_min = 0
+    for d in range(s):
+        flat_dst = recv_dst[d].reshape(-1)
+        flat_ok = recv_valid[d].reshape(-1)
+        entries = np.nonzero(flat_ok)[0]
+        order = entries[np.argsort(flat_dst[entries], kind="stable")]
+        counts = np.bincount(flat_dst[order], minlength=per)
+        row_ptr = np.zeros(per + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_ptr[1:])
+        per_shard_csr.append((row_ptr, order))
+        # this shard's minimum grid: >=1 tile per rows-row block, no tile
+        # spanning blocks (mirrors build_staircase_plan's accounting)
+        blocks = np.arange(max(1, -(-per // rows)))
+        starts = row_ptr[np.minimum(blocks * rows, per)]
+        ends = row_ptr[np.minimum((blocks + 1) * rows, per)]
+        t_min = max(t_min, int(np.maximum(1, -(-(ends - starts) // TILE)).sum()))
+
+    T = _pad_tiles(t_min)
+    plans = [
+        build_staircase_plan(row_ptr, order, rows=rows, n_tiles=T)
+        for row_ptr, order in per_shard_csr
+    ]
+    return ShardPlans(
+        tile_block=jnp.stack([p.tile_block for p in plans]),
+        first_visit=jnp.stack([p.first_visit for p in plans]),
+        offs=jnp.stack([p.offs for p in plans]),
+        entry_gather=jnp.stack([p.col_gather for p in plans]),
+        per=per,
+        n_tiles=T,
+        n_blocks=plans[0].n_blocks,
+        rows=rows,
+        n_shards=s,
+        bucket=b,
+    )
+
+
 def init_sharded_swarm(
     sg: ShardedGraph,
     relabeled: Graph,
@@ -212,6 +317,7 @@ def _exchange(
     activation: str,  # "push" | "pull" | "flood"
     fanout: int,
     blocked_rows: jax.Array | None = None,
+    shard_plan: ShardPlans | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One bucketed all_to_all fan-out; returns (incoming, msgs_per_shard).
 
@@ -221,21 +327,37 @@ def _exchange(
     are stale (rewired slots): their deliveries are dropped AND excluded
     from the message count on the receiving shard — so msgs matches the
     local engine, which filters stale edges before counting.
+
+    ``shard_plan`` (:func:`build_shard_plans`) replaces the receive-side
+    ``.at[].max`` scatter — the serialized reduction — with the staircase
+    MXU kernel, run per shard inside ``shard_map`` over the same received
+    buckets. Everything upstream (activation draws, all_to_all, stale
+    filter, msgs accounting) is unchanged, so the two receive paths are
+    bit-identical in output and billing.
     """
     s, b = sg.n_shards, sg.bucket
     per = sg.per_shard
     m = transmit.shape[1]
     if blocked_rows is None:
         blocked_rows = jnp.zeros(transmit.shape[0], dtype=bool)
+    if shard_plan is not None:
+        shard_plan.check_matches(sg)
+    plan_args = () if shard_plan is None else (
+        shard_plan.tile_block, shard_plan.first_visit,
+        shard_plan.offs, shard_plan.entry_gather,
+    )
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(AXIS),) * 8,
+        in_specs=(P(AXIS),) * (8 + len(plan_args)),
         out_specs=(P(AXIS), P(AXIS)),
+        # the kernel path launches pallas_call with shard-varying prefetch
+        # tables, which the varying-axes checker cannot type (see _launch)
+        check_vma=shard_plan is None,
     )
     def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, deg_blk, key_blk,
-           blocked_blk):
+           blocked_blk, *plan_blks):
         send_src, recv_dst = send_src[0], recv_dst[0]  # (S, B)
         valid, dst_deg = valid[0], dst_deg[0]
         vals = transmit_blk[send_src]  # (S, B, M)
@@ -257,21 +379,52 @@ def _exchange(
         # neither delivered nor billed, like the local engine's edge masks)
         received = received & ~blocked_blk[recv_dst][:, :, None]
         msgs = jnp.sum(received, dtype=jnp.int32)
-        incoming = (
-            jnp.zeros((per, m), dtype=bool)
-            .at[recv_dst.reshape(-1)]
-            .max(received.reshape(s * b, m), mode="drop")
-        )
+        flat = received.reshape(s * b, m)
+        if shard_plan is None:
+            incoming = (
+                jnp.zeros((per, m), dtype=bool)
+                .at[recv_dst.reshape(-1)]
+                .max(flat, mode="drop")
+            )
+        else:
+            from tpu_gossip.kernels.pallas_segment import (
+                StaircasePlan, _launch, _slot_groups, pack_words,
+            )
+
+            local_plan = StaircasePlan(
+                tile_block=plan_blks[0][0],
+                first_visit=plan_blks[1][0],
+                offs=plan_blks[2][0],
+                col_gather=plan_blks[3][0],
+                n=per,
+                n_tiles=shard_plan.n_tiles,
+                n_blocks=shard_plan.n_blocks,
+                rows=shard_plan.rows,
+            )
+            outs = [
+                _launch(
+                    local_plan,
+                    pack_words(flat[:, lo : lo + w])[local_plan.col_gather],
+                    w,
+                    None,
+                )
+                for lo, w in _slot_groups(m)
+            ]
+            incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
         return incoming, msgs[None]
 
     return ex(
         transmit, sg.send_src, sg.recv_dst, sg.send_valid, sg.send_dst_deg,
-        sg.deg, keys, blocked_rows,
+        sg.deg, keys, blocked_rows, *plan_args,
     )
 
 
 def gossip_round_dist(
-    state: SwarmState, cfg: SwarmConfig, sg: ShardedGraph, mesh: Mesh
+    state: SwarmState,
+    cfg: SwarmConfig,
+    sg: ShardedGraph,
+    mesh: Mesh,
+    shard_plan: ShardPlans | None = None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -310,7 +463,7 @@ def gossip_round_dist(
     if cfg.mode in ("push", "push_pull"):
         inc, msgs = _exchange(
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
-            "push", cfg.fanout, blocked_rows=blocked,
+            "push", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
@@ -318,7 +471,7 @@ def gossip_round_dist(
         static_answer = answer & ~state.rewired[:, None] if rewiring else answer
         inc, msgs = _exchange(
             static_answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
-            "pull", cfg.fanout, blocked_rows=blocked,
+            "pull", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
         )
         incoming = incoming | inc
         # delivered bits + one request per pulling peer, mirroring the local
@@ -331,7 +484,7 @@ def gossip_round_dist(
     if cfg.mode == "flood":
         inc, msgs = _exchange(
             transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
-            "flood", cfg.fanout,
+            "flood", cfg.fanout, shard_plan=shard_plan,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
@@ -356,11 +509,12 @@ def simulate_dist(
     sg: ShardedGraph,
     mesh: Mesh,
     num_rounds: int,
+    shard_plan: ShardPlans | None = None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history."""
 
     def body(carry, _):
-        nxt, stats = gossip_round_dist(carry, cfg, sg, mesh)
+        nxt, stats = gossip_round_dist(carry, cfg, sg, mesh, shard_plan)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -375,6 +529,7 @@ def run_until_coverage_dist(
     target: float = 0.99,
     max_rounds: int = 1000,
     slot: int = 0,
+    shard_plan: ShardPlans | None = None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips)."""
 
@@ -382,7 +537,7 @@ def run_until_coverage_dist(
         return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)
 
     def body(st: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round_dist(st, cfg, sg, mesh)
+        nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

@@ -116,6 +116,7 @@ def build_staircase_plan(
     fanout: int | None = None,
     *,
     rows: int = ROWS,
+    n_tiles: int | None = None,
 ) -> StaircasePlan:
     """Cut the CSR's destination-grouped edges into MXU tiles (host, once).
 
@@ -130,6 +131,11 @@ def build_staircase_plan(
     edges, far below the 1024-edge tile), so widening the block to 512 rows
     cuts the sequential grid ~4x for d̄ ≲ 2 while the MXU contraction stays
     (m, 1024) x (1024, rows). Must be a multiple of 128 (lane width).
+
+    ``n_tiles`` forces the grid to an exact size instead of the quantized
+    minimum — the SPMD fusion (dist/mesh.py build_shard_plans) needs every
+    shard's plan to share one static tile count; the extra tiles are inert
+    (they revisit the last block with offs=-1).
     """
     if rows % 128 != 0 or rows <= 0:
         raise ValueError(f"rows must be a positive multiple of 128, got {rows}")
@@ -146,7 +152,9 @@ def build_staircase_plan(
     # the extra tiles ride the last block with zero valid edges — tile_len
     # clips to 0, offs to -1, so they contribute nothing
     t_real = int(tiles_per_block.sum())
-    T = _pad_tiles(t_real)
+    T = _pad_tiles(t_real) if n_tiles is None else n_tiles
+    if T < t_real:
+        raise ValueError(f"n_tiles={T} below the plan's minimum {t_real}")
     tiles_per_block[-1] += T - t_real
 
     tile_block = np.repeat(np.arange(n_blocks, dtype=np.int32), tiles_per_block)
@@ -164,6 +172,11 @@ def build_staircase_plan(
     # edge destination (CSR row) per edge, then per tile slot
     deg = row_ptr[1:] - row_ptr[:-1]
     dst = np.repeat(np.arange(n, dtype=np.int64), deg)
+    if dst.size == 0:
+        # edgeless CSR (e.g. a shard that receives nothing): every tile slot
+        # is invalid, but the safe-index scheme below still reads slot 0
+        dst = np.zeros(1, dtype=np.int64)
+        col_idx = np.zeros(1, dtype=np.int64)
 
     slot = np.arange(TILE, dtype=np.int64)
     eidx = tile_start[:, None] + slot[None, :]  # (T, TILE)
@@ -426,7 +439,10 @@ def _launch(
 
     With ``bill`` (per-edge int32 counts, same layout), also returns the
     per-row segment-SUM of those counts as an (N,) f32 array — one extra
-    contraction plane, no extra launch."""
+    contraction plane, no extra launch. Runs standalone or per shard inside
+    ``shard_map`` (dist/mesh.py, which must pass ``check_vma=False``: the
+    scalar-prefetch index maps mix shard-varying tables with the loop
+    index, which JAX's varying-axes tracker cannot type)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     rows = plan.rows
