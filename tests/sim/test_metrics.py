@@ -69,6 +69,35 @@ def test_cli_run_to_target(capsys):
     assert summary["coverage"] >= summary["target"]
 
 
+def test_cli_shard_run_to_target(capsys):
+    """--shard runs the dist engine over the (virtual 8-device) mesh; with
+    --staircase the receive side is the per-shard kernel (north-star CLI)."""
+    rc = run_sim_main(
+        ["--peers", "200", "--slots", "4", "--quiet", "--shard", "--staircase",
+         "--mode", "push_pull", "--fanout", "2"]
+    )
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["devices"] == 8
+    assert summary["coverage"] >= summary["target"]
+
+
+def test_cli_shard_fixed_horizon_with_churn(capsys, tmp_path):
+    ck = tmp_path / "shard.npz"
+    rc = run_sim_main(
+        ["--peers", "200", "--rounds", "8", "--slots", "4", "--quiet", "--shard",
+         "--mode", "push_pull", "--fanout", "2", "--churn-leave", "0.01",
+         "--churn-join", "0.1", "--rewire-slots", "2", "--silent-frac", "0.05",
+         "--checkpoint", str(ck)]
+    )
+    assert rc == 0 and ck.exists()
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["rounds_run"] == 8 and summary["devices"] == 8
+    from tpu_gossip.core.state import load_swarm
+
+    assert int(load_swarm(ck).round) == 8
+
+
 def test_cli_checkpoint(tmp_path, capsys):
     ck = tmp_path / "final.npz"
     rc = run_sim_main(

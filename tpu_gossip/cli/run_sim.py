@@ -58,6 +58,14 @@ def build_parser() -> argparse.ArgumentParser:
         "edges go through the XLA side path. Flood ignores re-wiring on "
         "every delivery path (the flood is defined over the static CSR)",
     )
+    p.add_argument(
+        "--shard",
+        action="store_true",
+        help="run the sharded engine over ALL available devices (1-D peer "
+        "mesh, bucketed all_to_all exchange — dist/mesh.py); composes with "
+        "--staircase, which then routes each shard's receive side through "
+        "the per-shard staircase kernel (the north-star fusion)",
+    )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
     p.add_argument(
@@ -86,6 +94,9 @@ def main(argv: list[str] | None = None) -> int:
         edges = topology.configuration_model(deg, rng=rng)
     graph = topology.build_csr(args.peers, edges)
 
+    if args.shard:
+        return _main_shard(args, graph, rng)
+
     cfg = SwarmConfig(
         n_peers=args.peers,
         msg_slots=args.slots,
@@ -110,11 +121,9 @@ def main(argv: list[str] | None = None) -> int:
             rows=128 if args.mode == "flood" else 1024,
         )
 
-    origins = rng.choice(args.peers, size=min(args.origins, args.peers), replace=False)
+    origins, silent_ids = _sample_ids(args, rng)
     state = init_swarm(graph, cfg, key=jax.random.key(args.seed), origins=origins)
-    if args.silent_frac > 0:
-        k = int(args.silent_frac * args.peers)
-        silent_ids = rng.choice(args.peers, size=k, replace=False)
+    if silent_ids is not None:
         state.silent = state.silent.at[silent_ids].set(True)
 
     from tpu_gossip.utils.profiling import trace
@@ -124,19 +133,103 @@ def main(argv: list[str] | None = None) -> int:
             fin, stats = simulate(state, cfg, args.rounds, plan)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            rounds = M.rounds_to_coverage(stats, args.target)
-            summary = {
-                "summary": True,
-                "n_peers": args.peers,
-                "mode": args.mode,
-                "rounds_run": args.rounds,
-                "rounds_to_target": rounds,
-                "final_coverage": float(np.asarray(stats.coverage)[-1]),
-                "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
-            }
+            summary = _horizon_summary(args, stats)
         else:
             result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds, plan=plan)
             summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
+    print(json.dumps(summary))
+
+    if args.checkpoint:
+        save_swarm(args.checkpoint, fin)
+    return 0
+
+
+def _sample_ids(args, rng):
+    """Origin peers + silent peers drawn once, identically for both engine
+    paths (the sharded path then remaps them through ``position``)."""
+    origins = rng.choice(args.peers, size=min(args.origins, args.peers), replace=False)
+    silent_ids = None
+    if args.silent_frac > 0:
+        k = int(args.silent_frac * args.peers)
+        silent_ids = rng.choice(args.peers, size=k, replace=False)
+    return origins, silent_ids
+
+
+def _horizon_summary(args, stats, **extra):
+    """Fixed-horizon summary row — one schema for local and sharded runs."""
+    from tpu_gossip.sim import metrics as M
+
+    return {
+        "summary": True,
+        "n_peers": args.peers,
+        "mode": args.mode,
+        "rounds_run": args.rounds,
+        "rounds_to_target": M.rounds_to_coverage(stats, args.target),
+        "final_coverage": float(np.asarray(stats.coverage)[-1]),
+        "total_msgs": int(np.asarray(stats.msgs_sent).sum()),
+        **extra,
+    }
+
+
+def _main_shard(args, graph, rng) -> int:
+    """The --shard path: identical protocol, peers 1-D sharded over every
+    available device with bucketed all_to_all fan-out (dist/mesh.py)."""
+    import jax
+
+    from tpu_gossip.core.state import SwarmConfig, save_swarm
+    from tpu_gossip.dist import (
+        build_shard_plans,
+        init_sharded_swarm,
+        make_mesh,
+        partition_graph,
+        run_until_coverage_dist,
+        shard_swarm,
+        simulate_dist,
+    )
+    from tpu_gossip.sim import metrics as M
+    from tpu_gossip.utils.profiling import trace
+
+    mesh = make_mesh()
+    sg, relabeled, position = partition_graph(graph, mesh.size, seed=args.seed)
+    cfg = SwarmConfig(
+        n_peers=sg.n_pad,  # padded slot space; pads are born dead
+        msg_slots=args.slots,
+        fanout=args.fanout,
+        mode=args.mode,
+        forward_once=args.forward_once,
+        sir_recover_rounds=args.sir_recover,
+        churn_leave_prob=args.churn_leave,
+        churn_join_prob=args.churn_join,
+        rewire_slots=args.rewire_slots,
+    )
+    plans = build_shard_plans(sg) if args.staircase else None
+    origins, silent_ids = _sample_ids(args, rng)
+    state = init_sharded_swarm(
+        sg, relabeled, position, cfg, key=jax.random.key(args.seed), origins=origins
+    )
+    if silent_ids is not None:
+        state.silent = state.silent.at[position[silent_ids]].set(True)
+    state = shard_swarm(state, mesh)
+
+    with trace(args.profile):
+        if args.rounds > 0:
+            fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds, plans)
+            if not args.quiet:
+                M.write_jsonl(stats, sys.stdout)
+            summary = _horizon_summary(args, stats, devices=mesh.size)
+        else:
+            # the shared timing harness (warmup, fetch barrier) with the
+            # dist engine's while_loop swapped in; report the real peer
+            # count, not the padded slot count
+            result, fin = M.bench_swarm(
+                state, cfg, args.target, args.max_rounds, n_peers=args.peers,
+                run=lambda: run_until_coverage_dist(
+                    state, cfg, sg, mesh, args.target, args.max_rounds,
+                    shard_plan=plans,
+                ),
+            )
+            summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
+                       **json.loads(result.to_json())}
     print(json.dumps(summary))
 
     if args.checkpoint:

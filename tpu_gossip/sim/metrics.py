@@ -68,6 +68,8 @@ def bench_swarm(
     warmup: bool = True,
     reps: int = 1,
     plan=None,
+    run=None,
+    n_peers: int | None = None,
 ) -> tuple[BenchResult, SwarmState]:
     """Time the run-to-coverage while_loop on device (compile excluded).
 
@@ -75,14 +77,25 @@ def bench_swarm(
     ``reps`` repetitions (remote-tunnel platforms have high run-to-run
     variance) and the actual final state, so callers can checkpoint what was
     measured.
+
+    ``run`` swaps in a different zero-arg run-to-coverage callable (the
+    sharded engine's ``run_until_coverage_dist``, a custom horizon) while
+    keeping THIS timing harness — warmup, scalar-fetch completion barrier,
+    min-over-reps — in exactly one place. ``n_peers`` overrides the
+    reported swarm size (e.g. the real peer count when ``cfg.n_peers`` is
+    a padded slot count).
     """
+    if run is None:
+        run = lambda: run_until_coverage(  # noqa: E731
+            state, cfg, target, max_rounds, plan=plan)
+    n = cfg.n_peers if n_peers is None else n_peers
     if warmup:
-        float(run_until_coverage(state, cfg, target, max_rounds, plan=plan).coverage(0))
+        float(run().coverage(0))
     best = None
     fin = state
     for _ in range(max(reps, 1)):
         t0 = time.perf_counter()
-        fin = run_until_coverage(state, cfg, target, max_rounds, plan=plan)
+        fin = run()
         # host-fetch a scalar inside the timed region: on some platforms
         # (axon tunnel) block_until_ready returns before execution
         # completes, so the fetch is the only reliable completion barrier
@@ -90,11 +103,11 @@ def bench_swarm(
         rounds = int(fin.round - state.round)
         dt = time.perf_counter() - t0
         res = BenchResult(
-            n_peers=cfg.n_peers,
+            n_peers=n,
             rounds=rounds,
             target=target,
             wall_seconds=dt,
-            peers_rounds_per_sec=cfg.n_peers * rounds / max(dt, 1e-9),
+            peers_rounds_per_sec=n * rounds / max(dt, 1e-9),
             coverage=coverage,
             ms_per_round=dt / max(rounds, 1) * 1000.0,
         )
