@@ -272,6 +272,65 @@ def bench_liveness(n: int = 1000, silent_frac: float = 0.1, rounds: int = 20,
     }
 
 
+def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
+                      remat_every: int = 16):
+    """BASELINE config 5 at steady state with periodic re-materialization.
+
+    The plain churn config pays ~3-4x the static round cost forever because
+    ``rewired`` only grows (docs/kernel_profile_1m.md). Here churn runs
+    ``remat_every`` rounds, the fresh edges are folded into the CSR
+    (sim.engine.rematerialize_rewired), and the NEXT segment is measured —
+    the round rate churn returns to after each rebuild — plus the rebuild's
+    own warm cost, reported amortized per round.
+    """
+    import jax
+    import numpy as np
+
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.sim.engine import (
+        remat_capacity, rematerialize_rewired, simulate,
+    )
+
+    cfg = SwarmConfig(
+        n_peers=dg.n_pad, msg_slots=msg_slots, fanout=1, mode="push_pull",
+        churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+    )
+    state = init_swarm(
+        dg.as_padded_graph(), cfg, origins=np.arange(msg_slots),
+        origin_slots=np.arange(msg_slots), exists=dg.exists,
+        key=jax.random.key(0),
+    )
+    cap = remat_capacity(state, cfg)
+    state, _ = simulate(state, cfg, remat_every)  # accumulate real churn
+    state, _ = rematerialize_rewired(state, cfg, cap)
+
+    fin, _ = simulate(state, cfg, remat_every)  # warm the capacity shape
+    float(fin.coverage(0))
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        fin, _ = simulate(state, cfg, remat_every)
+        float(fin.coverage(0))  # completion barrier
+        best = min(best, time.perf_counter() - t0)
+    seg_ms = best / remat_every * 1000.0
+
+    nxt, ov = rematerialize_rewired(fin, cfg, cap)  # warm the remat itself
+    int(ov)
+    t0 = time.perf_counter()
+    nxt, ov = rematerialize_rewired(fin, cfg, cap)
+    overflow = int(ov)  # fetch = completion barrier
+    remat_s = time.perf_counter() - t0
+    return {
+        "n_peers": dg.n_pad, "msg_slots": msg_slots,
+        "remat_every": remat_every,
+        "ms_per_round": round(seg_ms, 4),
+        "remat_seconds": round(remat_s, 3),
+        "ms_per_round_amortized": round(seg_ms + remat_s * 1000.0 / remat_every, 4),
+        "overflow_edges": overflow,
+        "delivery": "xla",
+    }
+
+
 def bench_dist(n: int, reps: int = 3):
     """Sharded-engine run over the available device mesh (1 real TPU chip
     here; 8 virtual CPU devices under the test env) — the multi-chip path's
@@ -433,6 +492,10 @@ def main(argv: list[str] | None = None) -> int:
             dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1,
             **churn_kw,
         )
+        # config 5 at steady state: periodic re-materialization folds the
+        # fresh edges into the CSR, so between rebuilds churn rounds run at
+        # near-static cost (ms_per_round_amortized includes the rebuild)
+        configs["churn_rewire_1m_remat16"] = bench_churn_remat(dg1, reps=reps)
         # BASELINE config 2: 1k peers + 3-miss liveness (detection latency
         # vs the reference's 30-42 s worst-case band, SURVEY.md §6)
         configs["liveness_1k"] = bench_liveness(reps=reps)
@@ -480,6 +543,12 @@ def main(argv: list[str] | None = None) -> int:
         # same fairness the flood pair below gets by freeing the plan first;
         # a resident plan inflates XLA round times via spill)
         ns_xla = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps)
+        # plan build cold vs warm, mirroring setup_seconds_cold/warm: the
+        # first build pays ~17 s of trace+compile, a rebuild is ~5 s of
+        # device compute — e2e accounting uses the steady-state (warm)
+        # figure, same as it does for the graph build; both are reported
+        plan10, plan10_cold_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
+        del plan10
         plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
         ns_pal = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps, plan=plan10)
         # flood at north-star scale: the staircase kernel's strongest mode
@@ -512,9 +581,11 @@ def main(argv: list[str] | None = None) -> int:
             "setup_seconds_cold": round(setup_cold, 2),
             "setup_seconds_warm": round(setup_warm, 2),
             "plan_build_seconds": round(plan10_s, 2),
+            "plan_build_seconds_cold": round(plan10_cold_s, 2),
             "target": "10M peers to 99% < 60 s (BASELINE.json north_star)",
             "met_definition": "min over delivery paths of (setup_seconds_warm "
-            "+ path-specific prep + sim wall_seconds) < 60",
+            "+ path-specific prep, measured warm like setup + sim "
+            "wall_seconds) < 60",
             "met_sim_only": bool(min(ns_xla["wall_seconds"], ns_pal["wall_seconds"]) < 60.0),
             "met": bool(min(e2e_xla, e2e_pal) < 60.0),
             "flood_10m": flood10,

@@ -59,6 +59,14 @@ def build_parser() -> argparse.ArgumentParser:
         "every delivery path (the flood is defined over the static CSR)",
     )
     p.add_argument(
+        "--remat-every", type=int, default=0, metavar="R",
+        help="every R rounds, fold rejoiners' fresh edges into the CSR and "
+        "clear the rewired set (sim.engine.rematerialize_rewired) — churn "
+        "rounds then run at static-topology cost between rebuilds; with "
+        "--staircase the plan is rebuilt per segment (0 = off; local "
+        "engine only)",
+    )
+    p.add_argument(
         "--shard",
         action="store_true",
         help="run the sharded engine over ALL available devices (1-D peer "
@@ -78,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.remat_every > 0 and args.shard:
+        # reject before the (potentially minutes-long) host graph build
+        print("--remat-every is local-engine only: the dist engine's bucket "
+              "tables are static per partition", file=sys.stderr)
+        return 2
 
     import jax
 
@@ -109,7 +122,8 @@ def main(argv: list[str] | None = None) -> int:
         rewire_slots=args.rewire_slots,
     )
     plan = None
-    if args.staircase:
+    if args.staircase and args.remat_every == 0:
+        # (with --remat-every the plan is rebuilt per segment instead)
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
         # per-mode tuned block heights (bench.py _build_plan sweep):
@@ -129,7 +143,9 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_gossip.utils.profiling import trace
 
     with trace(args.profile):
-        if args.rounds > 0:
+        if args.remat_every > 0:
+            summary, fin = _run_with_remat(args, cfg, state)
+        elif args.rounds > 0:
             fin, stats = simulate(state, cfg, args.rounds, plan)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -142,6 +158,83 @@ def main(argv: list[str] | None = None) -> int:
     if args.checkpoint:
         save_swarm(args.checkpoint, fin)
     return 0
+
+
+def _run_with_remat(args, cfg, state):
+    """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
+
+    The first re-materialization pads col_idx to the fixed capacity (one
+    extra compile); every later segment shares that shape. With
+    --staircase, the plan is rebuilt from the current CSR per segment (the
+    topology it tiles changed)."""
+    import time as _time
+
+    from tpu_gossip.sim import metrics as M
+    from tpu_gossip.sim.engine import (
+        remat_capacity,
+        rematerialize_rewired,
+        run_until_coverage,
+        simulate,
+    )
+
+    cap = remat_capacity(state, cfg)
+    r = args.remat_every
+    total = args.rounds if args.rounds > 0 else args.max_rounds
+    remats = 0
+    overflow_total = 0
+    stats_parts = []
+
+    def seg_plan():
+        if not args.staircase:
+            return None
+        from tpu_gossip.kernels.pallas_segment import build_staircase_plan
+
+        return build_staircase_plan(
+            np.asarray(state.row_ptr), np.asarray(state.col_idx),
+            fanout=None if args.mode == "flood" else args.fanout,
+            rows=128 if args.mode == "flood" else 1024,
+        )
+
+    t0 = _time.perf_counter()
+    while int(state.round) < total:
+        seg = min(r, total - int(state.round))
+        plan = seg_plan()
+        if args.rounds > 0:
+            state, stats = simulate(state, cfg, seg, plan)
+            stats_parts.append(stats)
+        else:
+            state = run_until_coverage(state, cfg, args.target, seg, plan=plan)
+            if float(state.coverage(0)) >= args.target:
+                break
+        if int(state.round) < total:
+            state, overflow = rematerialize_rewired(state, cfg, cap)
+            remats += 1
+            overflow_total += int(overflow)
+    wall = _time.perf_counter() - t0
+
+    extra = {
+        "remat_every": r, "remats": remats,
+        "remat_overflow_edges": overflow_total,
+    }
+    if args.rounds > 0:
+        stats = type(stats_parts[0])(*(
+            np.concatenate([np.asarray(getattr(p, f)) for p in stats_parts])
+            for f in stats_parts[0]._fields
+        ))
+        if not args.quiet:
+            M.write_jsonl(stats, sys.stdout)
+        return _horizon_summary(args, stats, **extra), state
+    rounds = int(state.round)
+    summary = {
+        "summary": True, "mode": args.mode, "n_peers": args.peers,
+        "rounds": rounds, "target": args.target,
+        "wall_seconds": wall,
+        "peers_rounds_per_sec": args.peers * rounds / max(wall, 1e-9),
+        "coverage": float(state.coverage(0)),
+        "ms_per_round": wall / max(rounds, 1) * 1000.0,
+        **extra,
+    }
+    return summary, state
 
 
 def _sample_ids(args, rng):

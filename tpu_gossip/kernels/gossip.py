@@ -113,5 +113,10 @@ def flood_all(
     if col_idx.shape[0] == 0:
         return jnp.zeros_like(transmit)
     src = edge_sources(row_ptr, col_idx.shape[0])
-    vals = transmit[col_idx].astype(jnp.uint8)  # (D, M)
+    # slots past row_ptr[-1] are capacity padding (a re-materialized CSR,
+    # sim/engine.py rematerialize_rewired, keeps col_idx at a fixed length);
+    # repeat-padding attributes them to the last degreed row, so they must
+    # carry nothing or raw incoming diverges across delivery paths
+    real = jnp.arange(col_idx.shape[0]) < row_ptr[-1]
+    vals = (transmit[col_idx] & real[:, None]).astype(jnp.uint8)  # (D, M)
     return jax.ops.segment_max(vals, src, num_segments=n).astype(bool)

@@ -43,6 +43,8 @@ __all__ = [
     "validate_rewire_width",
     "reverse_fresh_push",
     "fresh_rewire_traffic",
+    "rematerialize_rewired",
+    "remat_capacity",
     "advance_round",
     "gossip_round",
     "simulate",
@@ -304,6 +306,125 @@ def fresh_rewire_traffic(
     return incoming, msgs
 
 
+def remat_capacity(state: SwarmState, cfg: SwarmConfig) -> int:
+    """Fixed col_idx capacity for a re-materialization loop.
+
+    Computed ONCE from the pre-churn graph and passed to every
+    :func:`rematerialize_rewired` call so the rebuilt CSR keeps one static
+    shape across remats (each rebuild would otherwise grow the capacity and
+    force a fresh jit compile per call). Headroom = one bidirectional fresh
+    edge set per peer — far above any real churn epoch's net growth.
+    """
+    return int(state.col_idx.shape[0]) + 2 * int(state.alive.shape[0]) * max(
+        cfg.rewire_slots, 1
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "capacity"))
+def rematerialize_rewired(
+    state: SwarmState, cfg: SwarmConfig, capacity: int
+) -> tuple[SwarmState, jax.Array]:
+    """Fold rejoiners' fresh edges into the CSR and empty ``rewired``.
+
+    The churn round pays ~3-4x the static round cost at 1M because every
+    rewired slot's traffic rides dense-N side paths (fresh_rewire_traffic +
+    the stale-edge masks — docs/kernel_profile_1m.md), and ``rewired`` only
+    ever grows. This is SURVEY §7.4's periodic CSR rebuild, done entirely
+    on device: drop every stale edge (either endpoint rewired — the
+    departed occupants' connections), append each rejoiner's fresh
+    degree-preferential edges bidirectionally (the persistent version of
+    the TCP connections a socket rejoin opens, reference Peer.py:233-256),
+    rebuild the CSR by sorting the surviving edge list by source row, and
+    clear ``rewired``/``rewire_targets`` — after which rounds run at
+    static-topology cost until churn accumulates again.
+
+    ``capacity`` (static) is the output col_idx length — use
+    :func:`remat_capacity` once per run. Slots past the real edge count
+    form a tail BEYOND ``row_ptr[-1]``: ``flood_all`` masks them out
+    explicitly, the sampled paths, the endpoint-list churn draws, and the
+    staircase plan builders never read past ``row_ptr[-1]``, and their
+    entries are additionally self-loops on the repeat-attribution row as
+    defense in depth. Returns
+    ``(new_state, overflow)`` where ``overflow`` counts edges dropped
+    because the surviving set exceeded ``capacity`` (0 in any sane
+    configuration; dropped edges are the highest rows').
+
+    Callers holding a :class:`~tpu_gossip.kernels.pallas_segment.
+    StaircasePlan` or dist bucket tables must rebuild them — the topology
+    changed. Parallel fresh edges (two slots drawing one target) are kept
+    as parallel CSR edges: delivery OR-merges them away and they mirror
+    the doubled selection weight the slot-sampling side paths gave them.
+    """
+    n = state.alive.shape[0]
+    e_in = state.col_idx.shape[0]
+    s = max(cfg.rewire_slots, 1)
+    src_old = jnp.repeat(
+        jnp.arange(n, dtype=jnp.int32),
+        state.row_ptr[1:] - state.row_ptr[:-1],
+        total_repeat_length=e_in,
+    )
+    # repeat-padding attributes any input tail to the last degreed row as
+    # well — treat those slots like real edges (they are self-loops by this
+    # function's own output invariant, and the first remat sees no tail)
+    in_range = jnp.arange(e_in) < state.row_ptr[-1]
+    dst_old = state.col_idx
+    safe = lambda t: jnp.clip(t, 0, n - 1)  # noqa: E731
+    keep = (
+        in_range
+        & state.exists[src_old]
+        & state.exists[safe(dst_old)]
+        & ~state.rewired[src_old]
+        & ~state.rewired[safe(dst_old)]
+    )
+
+    ft = state.rewire_targets[:, :s]
+    fv = state.rewired[:, None] & (ft >= 0)
+    r_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, s))
+    t_ids = safe(ft).astype(jnp.int32)
+
+    srcs = jnp.concatenate([
+        jnp.where(keep, src_old, n),
+        jnp.where(fv, r_ids, n).reshape(-1),
+        jnp.where(fv, t_ids, n).reshape(-1),
+    ])
+    dsts = jnp.concatenate([
+        dst_old.astype(jnp.int32),
+        t_ids.reshape(-1),
+        r_ids.reshape(-1),
+    ])
+    total = srcs.shape[0]
+
+    counts = jnp.zeros((n + 1,), jnp.int32).at[srcs].add(1)
+    row_ptr = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:n], dtype=jnp.int32)
+    ])
+    overflow = jnp.maximum(row_ptr[-1] - capacity, 0)
+    row_ptr = jnp.minimum(row_ptr, capacity)
+
+    # invalid slots carry src=n so the sort pushes them into the tail; their
+    # dst becomes a self-loop on the repeat-padding attribution row
+    r_star = jnp.max(jnp.where(counts[:n] > 0, jnp.arange(n, dtype=jnp.int32), 0))
+    order = jnp.argsort(srcs)[:capacity] if total >= capacity else None
+    if order is None:  # capacity exceeds the assembled list: pad then sort
+        srcs = jnp.concatenate([srcs, jnp.full((capacity - total,), n, jnp.int32)])
+        dsts = jnp.concatenate([dsts, jnp.zeros((capacity - total,), jnp.int32)])
+        order = jnp.argsort(srcs)
+    new_col = jnp.where(
+        jnp.arange(capacity) < row_ptr[-1], dsts[order], r_star
+    ).astype(state.col_idx.dtype)
+
+    import dataclasses as _dc
+
+    new_state = _dc.replace(
+        state,
+        row_ptr=row_ptr.astype(state.row_ptr.dtype),
+        col_idx=new_col,
+        rewired=jnp.zeros_like(state.rewired),
+        rewire_targets=jnp.full_like(state.rewire_targets, -1),
+    )
+    return new_state, overflow
+
+
 def _substitute_rewired(
     state: SwarmState,
     cfg: SwarmConfig,
@@ -416,9 +537,14 @@ def advance_round(
             # repeated-endpoints trick of the reference's intended selector
             # (demonstrate_powerlaw.py:5-39).
             n, s = rewire_targets.shape
-            draws = state.col_idx[
-                jax.random.randint(k_rw, (n, s), 0, state.col_idx.shape[0])
-            ]
+            # draw indices in [0, row_ptr[-1]) — the REAL edge span — not
+            # [0, len(col_idx)): a re-materialized CSR (rematerialize_rewired)
+            # keeps a self-loop tail past row_ptr[-1] whose entries would
+            # bias endpoint draws toward one row. randint accepts the traced
+            # bound; a float32 uniform*e_real would quantize away most slots
+            # past 2^24 edges (10M-scale graphs have ~60M)
+            e_real = jnp.maximum(state.row_ptr[-1], 1)
+            draws = state.col_idx[jax.random.randint(k_rw, (n, s), 0, e_real)]
             # a draw can land on a padding/sentinel edge slot (DeviceGraph
             # CSRs point erased edges at the sentinel row) — mark those -1 so
             # fan-out substitution treats them as invalid instead of pushing
