@@ -274,14 +274,18 @@ def bench_liveness(n: int = 1000, silent_frac: float = 0.1, rounds: int = 20,
 
 def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
                       remat_every: int = 16):
-    """BASELINE config 5 at steady state with periodic re-materialization.
+    """BASELINE config 5 with periodic re-materialization, measured honestly.
 
-    The plain churn config pays ~3-4x the static round cost forever because
-    ``rewired`` only grows (docs/kernel_profile_1m.md). Here churn runs
-    ``remat_every`` rounds, the fresh edges are folded into the CSR
-    (sim.engine.rematerialize_rewired), and the NEXT segment is measured —
-    the round rate churn returns to after each rebuild — plus the rebuild's
-    own warm cost, reported amortized per round.
+    Churn runs ``remat_every`` rounds, the fresh edges are folded into the
+    CSR (sim.engine.rematerialize_rewired), and the NEXT segment plus the
+    rebuild's warm cost are measured. Recorded result (2026-07-30, 1M):
+    the rebuild is ~0.8 s but the segment rate does NOT drop below the
+    plain churn config's — the rewire side paths are config-structural
+    (jit runs them regardless of how many peers are currently rewired), so
+    remat's value is bounding the rewired fraction over long horizons and
+    enabling dist epoch rebuilds (repartition_swarm), not round rate. The
+    entry stays in the matrix precisely so that claim is backed by a
+    number rather than an assumption.
     """
     import jax
     import numpy as np
@@ -492,9 +496,8 @@ def main(argv: list[str] | None = None) -> int:
             dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1,
             **churn_kw,
         )
-        # config 5 at steady state: periodic re-materialization folds the
-        # fresh edges into the CSR, so between rebuilds churn rounds run at
-        # near-static cost (ms_per_round_amortized includes the rebuild)
+        # config 5 + periodic re-materialization (topology lifecycle; see
+        # bench_churn_remat's docstring for why this is NOT a rate win)
         configs["churn_rewire_1m_remat16"] = bench_churn_remat(dg1, reps=reps)
         # BASELINE config 2: 1k peers + 3-miss liveness (detection latency
         # vs the reference's 30-42 s worst-case band, SURVEY.md §6)

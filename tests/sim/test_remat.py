@@ -54,7 +54,7 @@ def _expected_edges(g, st, cfg):
     tg = np.asarray(st.rewire_targets)[:, : cfg.rewire_slots]
     for r in np.nonzero(rewired)[0]:
         for t in tg[r]:
-            if t >= 0:
+            if t >= 0 and t != r:  # self targets are excluded by remat
                 edges[(int(r), int(t))] += 1
                 edges[(int(t), int(r))] += 1
     return edges
@@ -161,6 +161,55 @@ def test_churn_with_periodic_remat_sustains_coverage():
     if rw.any():
         t = np.asarray(st.rewire_targets)[rw].ravel()
         assert ((t == -1) | ((t >= 0) & (t < n))).all()
+
+
+def test_remat_then_repartition_back_onto_mesh():
+    """The dist epoch-rebuild cycle: dist churn rounds → re-materialize the
+    accumulated fresh edges → repartition_swarm → resume on the mesh. The
+    live protocol state must survive the permutation and the epidemic must
+    keep spreading over the folded topology."""
+    from tpu_gossip.dist import (
+        build_shard_plans,
+        init_sharded_swarm,
+        make_mesh,
+        partition_graph,
+        repartition_swarm,
+        shard_swarm,
+        simulate_dist,
+    )
+
+    n = 400
+    g = build_csr(n, preferential_attachment(n, m=3, use_native=False,
+                                             rng=np.random.default_rng(40)))
+    mesh = make_mesh(8)
+    sg, relabeled, position = partition_graph(g, 8, seed=4)
+    cfg = SwarmConfig(
+        n_peers=sg.n_pad, msg_slots=4, fanout=2, mode="push_pull",
+        churn_leave_prob=0.03, churn_join_prob=0.3, rewire_slots=2,
+    )
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0],
+                           key=jax.random.key(8)), mesh)
+    st, _ = simulate_dist(st, cfg, sg, mesh, 10, build_shard_plans(sg))
+    assert int(jnp.sum(st.rewired)) > 0, "no churn accumulated to fold"
+    cov_before = float(st.coverage(0))
+    seen_before = int(jnp.sum(st.seen))
+
+    st, overflow = rematerialize_rewired(st, cfg, remat_capacity(st, cfg))
+    assert int(overflow) == 0
+    sg2, st2, pos2 = repartition_swarm(st, 8, seed=5)
+    cfg2 = dataclasses.replace(cfg, n_peers=sg2.n_pad)
+    # the permutation moved, not changed, the protocol state
+    assert float(st2.coverage(0)) == pytest.approx(cov_before, abs=1e-6)
+    assert int(jnp.sum(st2.seen)) == seen_before
+    np.testing.assert_array_equal(
+        np.asarray(st.seen)[np.asarray(st.exists)].sum(0),
+        np.asarray(st2.seen)[np.asarray(st2.exists)].sum(0),
+    )
+    # and the swarm keeps disseminating on the new partition
+    st2 = shard_swarm(st2, mesh)
+    fin, _ = simulate_dist(st2, cfg2, sg2, mesh, 10, build_shard_plans(sg2))
+    assert float(fin.coverage(0)) > cov_before
 
 
 @pytest.mark.parametrize("mode", ["push", "push_pull"])

@@ -18,6 +18,7 @@ from tpu_gossip.dist.mesh import (
     simulate_dist,
     run_until_coverage_dist,
     init_sharded_swarm,
+    repartition_swarm,
 )
 
 __all__ = [
@@ -28,6 +29,7 @@ __all__ = [
     "build_shard_plans",
     "shard_swarm",
     "init_sharded_swarm",
+    "repartition_swarm",
     "gossip_round_dist",
     "simulate_dist",
     "run_until_coverage_dist",

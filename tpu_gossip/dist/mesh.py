@@ -61,6 +61,7 @@ __all__ = [
     "build_shard_plans",
     "shard_swarm",
     "init_sharded_swarm",
+    "repartition_swarm",
     "gossip_round_dist",
     "simulate_dist",
     "run_until_coverage_dist",
@@ -293,6 +294,70 @@ def init_sharded_swarm(
         state.alive = state.alive & ~pad
         state.declared_dead = state.declared_dead | pad
     return state
+
+
+def repartition_swarm(
+    state: SwarmState, n_shards: int, *, seed: int = 0
+) -> tuple[ShardedGraph, SwarmState, np.ndarray]:
+    """Epoch rebuild for the mesh: re-partition a LIVE swarm's current CSR.
+
+    The dist engine's bucket tables are static per partition, so churn
+    re-wiring that has been folded into the CSR by
+    :func:`~tpu_gossip.sim.engine.rematerialize_rewired` (or any other
+    topology change) needs a fresh partition. This extracts the state's
+    current CSR (trimming a re-materialization capacity tail), runs
+    :func:`partition_graph`, and remaps every per-peer state leaf through
+    the new load-balance permutation into the padded slot space — protocol
+    state (seen bits, SIR clocks, liveness, churn masks) survives the move.
+    Pad slots are born dead exactly as in :func:`init_sharded_swarm`.
+    Returns ``(sg, new_state, position)``; callers re-`shard_swarm` the
+    state onto the mesh and rebuild :func:`build_shard_plans` if they used
+    the kernel receive. Host-side, like ``partition_graph`` itself — this
+    is the once-per-epoch path, not the round path.
+    """
+    n = int(state.alive.shape[0])
+    e_real = int(state.row_ptr[-1])
+    graph = Graph(
+        n=n,
+        row_ptr=np.asarray(state.row_ptr).astype(np.int32),
+        col_idx=np.asarray(state.col_idx)[:e_real].astype(np.int32),
+    )
+    sg, relabeled, position = partition_graph(graph, n_shards, seed=seed)
+    pos = jnp.asarray(position, dtype=jnp.int32)
+    n_pad = sg.n_pad
+
+    # pad-slot fill per field (init_sharded_swarm's born-dead invariant);
+    # any FUTURE per-peer field defaults to a zero fill and still gets
+    # permuted — the remap below walks every dataclass leaf with leading
+    # dim n instead of a hand-kept list, so new state cannot silently stay
+    # in the old slot order
+    fills = {"declared_dead": True, "infected_round": -1, "rewire_targets": -1}
+    topology_fields = {"row_ptr", "col_idx"}
+
+    def remap(name, x):
+        fill = fills.get(name, jnp.zeros((), x.dtype))
+        out = jnp.full((n_pad,) + x.shape[1:], fill, dtype=x.dtype)
+        return out.at[pos].set(x)
+
+    # fresh targets are PEER IDS: map them through the permutation too
+    tg = state.rewire_targets
+    tg = jnp.where(tg >= 0, pos[jnp.clip(tg, 0, n - 1)], tg)
+    state = dataclasses.replace(state, rewire_targets=tg)
+    updates = {
+        f: remap(f, getattr(state, f))
+        for f in type(state).__dataclass_fields__
+        if f not in topology_fields
+        and hasattr(getattr(state, f), "ndim")
+        and getattr(state, f).ndim >= 1
+        and getattr(state, f).shape[0] == n
+    }
+    new_state = dataclasses.replace(
+        state,
+        row_ptr=jnp.asarray(relabeled.row_ptr),
+        col_idx=jnp.asarray(relabeled.col_idx),
+        **updates,
+    )
+    return sg, new_state, position
 
 
 def shard_swarm(state: SwarmState, mesh: Mesh) -> SwarmState:

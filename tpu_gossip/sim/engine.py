@@ -378,8 +378,11 @@ def rematerialize_rewired(
     )
 
     ft = state.rewire_targets[:, :s]
-    fv = state.rewired[:, None] & (ft >= 0)
     r_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, s))
+    # self targets excluded (advance_round already sentinels them; belt and
+    # braces here — a folded self-loop would be dropped by
+    # partition_graph's src<dst dedup on a later repartition)
+    fv = state.rewired[:, None] & (ft >= 0) & (ft != r_ids)
     t_ids = safe(ft).astype(jnp.int32)
 
     srcs = jnp.concatenate([
@@ -546,10 +549,14 @@ def advance_round(
             e_real = jnp.maximum(state.row_ptr[-1], 1)
             draws = state.col_idx[jax.random.randint(k_rw, (n, s), 0, e_real)]
             # a draw can land on a padding/sentinel edge slot (DeviceGraph
-            # CSRs point erased edges at the sentinel row) — mark those -1 so
-            # fan-out substitution treats them as invalid instead of pushing
-            # to a non-peer
-            draws = jnp.where(state.exists[draws], draws, -1)
+            # CSRs point erased edges at the sentinel row) or on the
+            # rejoiner ITSELF (its neighbors' endpoints include it) — mark
+            # both -1 so fan-out substitution treats them as invalid: a
+            # self edge would waste fan-out draws and, once folded in by
+            # rematerialize_rewired, be dropped by partition_graph's
+            # src<dst dedup, silently shrinking the peer's degree
+            self_draw = draws == jnp.arange(n, dtype=draws.dtype)[:, None]
+            draws = jnp.where(state.exists[draws] & ~self_draw, draws, -1)
             rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
             rewired = rewired | fresh
 
