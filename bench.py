@@ -496,6 +496,15 @@ def main(argv: list[str] | None = None) -> int:
             dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1,
             **churn_kw,
         )
+        # config 5 with the bounded-table side paths (rewire_compact_cap):
+        # fresh-edge traffic and join draws run at O(cap) instead of O(N) —
+        # the access-count fix the dense-path decomposition called for
+        # (docs/kernel_profile_1m.md); 65536 = ~16x the rewired population
+        # this config accumulates before 99% coverage
+        configs["churn_rewire_1m_compact_pallas"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1,
+            rewire_compact_cap=65536, **churn_kw,
+        )
         # config 5 + periodic re-materialization (topology lifecycle; see
         # bench_churn_remat's docstring for why this is NOT a rate win)
         configs["churn_rewire_1m_remat16"] = bench_churn_remat(dg1, reps=reps)

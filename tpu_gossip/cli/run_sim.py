@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
         "every delivery path (the flood is defined over the static CSR)",
     )
     p.add_argument(
+        "--rewire-compact-cap", type=int, default=0, metavar="CAP",
+        help="bound the fresh-edge side paths to a CAP-row table of rewired "
+        "peers (O(CAP) instead of O(N) random access; at most CAP joiners "
+        "re-wire per round — pair with --remat-every so the rewired set "
+        "stays under CAP). 0 = exact dense paths",
+    )
+    p.add_argument(
         "--remat-every", type=int, default=0, metavar="R",
         help="every R rounds, fold rejoiners' fresh edges into the CSR and "
         "clear the rewired set (sim.engine.rematerialize_rewired) — churn "
@@ -120,6 +127,7 @@ def main(argv: list[str] | None = None) -> int:
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
         rewire_slots=args.rewire_slots,
+        rewire_compact_cap=args.rewire_compact_cap,
     )
     plan = None
     if args.staircase and args.remat_every == 0:
@@ -294,6 +302,7 @@ def _main_shard(args, graph, rng) -> int:
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
         rewire_slots=args.rewire_slots,
+        rewire_compact_cap=args.rewire_compact_cap,
     )
     plans = build_shard_plans(sg) if args.staircase else None
     origins, silent_ids = _sample_ids(args, rng)

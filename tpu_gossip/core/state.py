@@ -69,6 +69,19 @@ class SwarmConfig:
     churn_leave_prob: float = 0.0  # per-round P(alive peer departs) — Poisson churn
     churn_join_prob: float = 0.0  # per-round P(vacant slot rejoins)
     rewire_slots: int = 0  # >0: rejoiners attach this many fresh degree-preferential edges
+    # >0: the fresh-edge side paths (sim.engine.fresh_rewire_traffic — the
+    # kernel-path local engine and the dist engine — plus the join-time
+    # endpoint draws in advance_round) run over a bounded (cap, ·) table of
+    # rewired rows instead of dense (N, ·) arrays — O(cap) random access
+    # instead of O(N) (docs/kernel_profile_1m.md: the dense paths are
+    # ~127 ms of a 1M churn round). If more rows are rewired than cap, the
+    # lowest-index cap rows are serviced and at most cap joiners re-wire
+    # per round (the rest rejoin on their slot's existing edges) — bounded
+    # re-wiring bandwidth; pair with periodic rematerialize_rewired so the
+    # rewired set cannot outgrow the cap. The XLA local path's exactly-k
+    # target substitution stays dense (its fan-out arrays are (N, k) by
+    # construction). 0 = exact dense paths everywhere.
+    rewire_compact_cap: int = 0
 
     def __post_init__(self):
         if self.n_peers <= 0:
@@ -77,6 +90,8 @@ class SwarmConfig:
             raise ValueError("msg_slots must be positive")
         if self.mode not in ("push", "push_pull", "flood"):
             raise ValueError(f"unknown mode {self.mode!r}")
+        if self.rewire_compact_cap < 0:
+            raise ValueError("rewire_compact_cap must be >= 0")
 
 
 @jax.tree_util.register_dataclass

@@ -273,6 +273,10 @@ def fresh_rewire_traffic(
     stay invalid. Shared by the dist engine (dist/mesh.py, where XLA's SPMD
     partitioner inserts the collectives) and the local kernel path.
     """
+    if cfg.rewire_compact_cap > 0:
+        return _fresh_rewire_traffic_compact(
+            state, cfg, transmit, answer, receptive_any, k_push, k_pull, do_pull
+        )
     incoming = jnp.zeros_like(transmit)
     msgs = jnp.zeros((), dtype=jnp.int32)
     n = state.rewired.shape[0]
@@ -300,6 +304,84 @@ def fresh_rewire_traffic(
         # engine's pull_ok gate)
         pvalid = pvalid & receptive_any[:, None]
         incoming = incoming | pull_fanout(answer, ptgt, pvalid)
+        msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
+            answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pvalid[:, 0]
+        )
+    return incoming, msgs
+
+
+def _fresh_rewire_traffic_compact(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    transmit: jax.Array,
+    answer: jax.Array,
+    receptive_any: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+    do_pull: bool,
+) -> tuple[jax.Array, jax.Array]:
+    """O(cap) twin of the dense fresh-edge side paths.
+
+    Only rewired rows carry fresh-edge traffic, yet the dense paths make
+    every row pay O(1) random accesses — ~127 ms of a 1M churn round for a
+    few-percent rewired fraction (docs/kernel_profile_1m.md; a TPU gather
+    is constant-cost per element, so masking dead rows saves nothing —
+    only reducing the access COUNT does). Here the currently-rewired rows
+    are compacted into a (cap,) index table (``jnp.nonzero(size=cap)`` —
+    one cheap dense scan) and every gather, scatter, and RNG draw runs at
+    (cap, ·). Same per-edge probabilities as the dense paths; RNG draws
+    differ in shape, so trajectories match in distribution, not
+    bit-for-bit (the same contract as kernel-vs-XLA delivery). Rewired
+    rows past ``cap`` when over-subscribed get no fresh traffic this round
+    — see the SwarmConfig field's semantics note.
+    """
+    cap = min(cfg.rewire_compact_cap, int(state.rewired.shape[0]))
+    n = state.rewired.shape[0]
+    s = cfg.rewire_slots
+    incoming = jnp.zeros_like(transmit)
+    k_push, k_rev = jax.random.split(k_push)
+
+    idx = jnp.nonzero(state.rewired, size=cap, fill_value=0)[0]  # (cap,)
+    live = jnp.arange(cap) < jnp.sum(state.rewired, dtype=jnp.int32)
+    tg = state.rewire_targets[idx, :s]  # (cap, S)
+    tx_rows = transmit[idx]  # (cap, M)
+    # scatter destination for deliveries TO the rewired rows; dead table
+    # rows are dropped instead of landing on row 0
+    row_or_drop = jnp.where(live, idx, n)
+
+    def draw(key, width):
+        soff = jax.random.randint(key, (cap, width), 0, s)
+        stgt = jnp.take_along_axis(tg, soff, axis=1)
+        return jnp.maximum(stgt, 0), live[:, None] & (stgt >= 0)
+
+    # push: each serviced rewired row fans out to `fanout` fresh draws
+    tgt, valid = draw(k_push, cfg.fanout)
+    push_valid = valid & tx_rows.any(-1)[:, None]
+    payload = tx_rows[:, None, :] & push_valid[:, :, None]  # (cap, K, M)
+    incoming = incoming.at[tgt.reshape(-1)].max(
+        payload.reshape(cap * cfg.fanout, -1), mode="drop"
+    )
+    msgs = jnp.sum(
+        tx_rows.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
+    )
+
+    # reverse-fresh: each fresh target pushes back at its per-edge rate
+    # (reverse_fresh_push's law, over the compact rows)
+    rtgt = jnp.maximum(tg, 0)
+    deg = state.row_ptr[1:] - state.row_ptr[:-1]
+    p = cfg.fanout / jnp.maximum(deg[rtgt], 1)
+    fire = live[:, None] & (tg >= 0) & (jax.random.uniform(k_rev, tg.shape) < p)
+    back = transmit[rtgt]  # (cap, S, M)
+    incoming = incoming.at[row_or_drop].max(
+        (back & fire[:, :, None]).any(axis=1), mode="drop"
+    )
+    msgs = msgs + jnp.sum(back.sum(-1, dtype=jnp.int32) * fire.astype(jnp.int32))
+
+    if do_pull:
+        ptgt, pvalid = draw(k_pull, 1)
+        pvalid = pvalid & receptive_any[idx][:, None]
+        pulled = pull_fanout(answer, ptgt, pvalid)  # (cap, M)
+        incoming = incoming.at[row_or_drop].max(pulled, mode="drop")
         msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
             answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pvalid[:, 0]
         )
@@ -547,7 +629,21 @@ def advance_round(
             # bound; a float32 uniform*e_real would quantize away most slots
             # past 2^24 edges (10M-scale graphs have ~60M)
             e_real = jnp.maximum(state.row_ptr[-1], 1)
-            draws = state.col_idx[jax.random.randint(k_rw, (n, s), 0, e_real)]
+            cap = min(cfg.rewire_compact_cap, n) or None
+            if cap is None:
+                jrows = jnp.arange(n, dtype=jnp.int32)  # every row draws
+                draw_shape = (n, s)
+            else:
+                # only this round's joiners need draws — compact them into
+                # (cap,) rows so the endpoint gathers are O(cap) not O(N)
+                # (~38 ms of a 1M churn round, docs/kernel_profile_1m.md);
+                # joiners past cap rejoin on their slot's existing edges
+                jrows = jnp.nonzero(fresh, size=cap, fill_value=0)[0]
+                draw_shape = (cap, s)
+                jlive = jnp.arange(cap) < jnp.sum(fresh, dtype=jnp.int32)
+            draws = state.col_idx[
+                jax.random.randint(k_rw, draw_shape, 0, e_real)
+            ]
             # a draw can land on a padding/sentinel edge slot (DeviceGraph
             # CSRs point erased edges at the sentinel row) or on the
             # rejoiner ITSELF (its neighbors' endpoints include it) — mark
@@ -555,10 +651,29 @@ def advance_round(
             # self edge would waste fan-out draws and, once folded in by
             # rematerialize_rewired, be dropped by partition_graph's
             # src<dst dedup, silently shrinking the peer's degree
-            self_draw = draws == jnp.arange(n, dtype=draws.dtype)[:, None]
+            self_draw = draws == jrows.astype(draws.dtype)[:, None]
             draws = jnp.where(state.exists[draws] & ~self_draw, draws, -1)
-            rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
-            rewired = rewired | fresh
+            if cap is None:
+                rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
+                rewired = rewired | fresh
+            else:
+                sel_rows = jnp.where(jlive, jrows, n)  # n = dropped
+                rewire_targets = rewire_targets.at[sel_rows].set(
+                    draws.astype(rewire_targets.dtype), mode="drop"
+                )
+                selected = jnp.zeros_like(fresh).at[sel_rows].set(
+                    True, mode="drop"
+                )
+                # over-cap joiners rejoin on their slot's existing CSR edges:
+                # clear a previously-rewired slot's flag and stale targets or
+                # the rejoiner would inherit the DEPARTED occupant's fresh
+                # edge as its only link (its CSR rows stay masked while
+                # rewired is True)
+                unselected = fresh & ~selected
+                rewired = (rewired & ~unselected) | (fresh & selected)
+                rewire_targets = jnp.where(
+                    unselected[:, None], -1, rewire_targets
+                )
 
     new_state = SwarmState(
         row_ptr=state.row_ptr,
