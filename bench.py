@@ -149,10 +149,11 @@ def _build_plan(dg, fanout, rows, device=False):
     build's ~620 MB of CSR-down + tables-up tunnel traffic costs ~90 s and
     the device build pays only one jit compile; at 1M the host build's few
     seconds beat the compile, so it stays. ``rows`` per the on-TPU tuning
-    sweep (2026-07-30, 1M γ=2.5 m16): flood is fastest at rows=128
-    (130.6 ms vs 153.7 at 1024), sampled push_pull at rows=1024 (192.3 ms
-    vs 232.1 at 128) — each config below uses its tuned best so the
-    xla-vs-pallas comparison is against the kernel's strongest setting.
+    re-sweep (2026-07-30, 1M γ=2.5 m16, slope-timed on the CURRENT
+    kernel): rows=1024 wins flood too now (49.3 ms core vs 64.9 at the
+    previously-tuned 128 — that earlier result belonged to an older
+    kernel) and sampled push_pull is flat 51-53 ms across 512-2048, so
+    every config uses rows=1024.
     """
     import numpy as np
 
@@ -443,7 +444,7 @@ def main(argv: list[str] | None = None) -> int:
     setup_1m = time.perf_counter() - t0
     plan1_k1, plan1_k1_s = _build_plan(dg1, fanout=1, rows=1024)
     plan1_k3, plan1_k3_s = (None, 0.0) if quick else _build_plan(dg1, fanout=3, rows=1024)
-    plan1_fl, plan1_fl_s = (None, 0.0) if quick else _build_plan(dg1, fanout=None, rows=128)
+    plan1_fl, plan1_fl_s = (None, 0.0) if quick else _build_plan(dg1, fanout=None, rows=1024)
 
     # --- 1M standard configs, both delivery paths ------------------------
     hl_xla = bench_one(dg1, "push_pull", 1, msg_slots=16, reps=reps)
@@ -570,7 +571,7 @@ def main(argv: list[str] | None = None) -> int:
         # (observed 84 s/round vs 7 s isolated) — each path gets fair HBM.
         del plan10
         flood10_xla = bench_one(dg10, "flood", 1, msg_slots=16, reps=1, max_rounds=50)
-        plan10_fl, plan10_fl_s = _build_plan(dg10, fanout=None, rows=128, device=True)
+        plan10_fl, plan10_fl_s = _build_plan(dg10, fanout=None, rows=1024, device=True)
         flood10 = {
             "xla": flood10_xla,
             "pallas": bench_one(

@@ -134,13 +134,11 @@ def main(argv: list[str] | None = None) -> int:
         # (with --remat-every the plan is rebuilt per segment instead)
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
-        # per-mode tuned block heights (bench.py _build_plan sweep):
-        # flood is tile-count-light and fastest at rows=128; sampled
-        # delivery amortizes better over 1024-row blocks
+        # block height: the library default (pallas_segment.ROWS), which
+        # carries the on-TPU tuning re-sweep — no per-mode override needed
         plan = build_staircase_plan(
             graph.row_ptr, graph.col_idx,
             fanout=None if args.mode == "flood" else args.fanout,
-            rows=128 if args.mode == "flood" else 1024,
         )
 
     origins, silent_ids = _sample_ids(args, rng)
@@ -200,7 +198,6 @@ def _run_with_remat(args, cfg, state):
         return build_staircase_plan(
             np.asarray(state.row_ptr), np.asarray(state.col_idx),
             fanout=None if args.mode == "flood" else args.fanout,
-            rows=128 if args.mode == "flood" else 1024,
         )
 
     t0 = _time.perf_counter()

@@ -190,7 +190,7 @@ class ShardPlans:
     per: int = dataclasses.field(metadata=dict(static=True))
     n_tiles: int = dataclasses.field(metadata=dict(static=True))
     n_blocks: int = dataclasses.field(metadata=dict(static=True))
-    rows: int = dataclasses.field(default=128, metadata=dict(static=True))
+    rows: int = dataclasses.field(default=1024, metadata=dict(static=True))
     # provenance of the bucket layout the tables index — checked against the
     # ShardedGraph at exchange time (a mismatched plan gathers out-of-order
     # received words and XLA's clamping gather would make it silently wrong)
@@ -207,7 +207,7 @@ class ShardPlans:
             )
 
 
-def build_shard_plans(sg: ShardedGraph, *, rows: int = 128) -> ShardPlans:
+def build_shard_plans(sg: ShardedGraph, *, rows: int = 1024) -> ShardPlans:
     """Staircase plans over each shard's RECEIVE side of the bucket tables.
 
     The dist engine's receive-side scatter (``.at[recv_dst].max`` over the
