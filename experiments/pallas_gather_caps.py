@@ -1,0 +1,71 @@
+"""Which tpu.dynamic_gather shapes does Mosaic actually compile, and how fast?
+
+take_along_axis(x, idx, axis) with x.shape == idx.shape is the only gather
+Mosaic lowers (tpu.dynamic_gather, per-lane for axis=0, per-sublane-row lane
+shuffle for axis=1). Probe compile success + slope-timed rate per shape.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def try_shape(rows, axis, iters=None):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2**31, (rows, 128), dtype=np.int32))
+    hi = rows if axis == 0 else 128
+    idx = jnp.asarray(rng.integers(0, hi, (rows, 128), dtype=np.int32))
+
+    def k(x_ref, i_ref, o_ref):
+        o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=axis)
+
+    @jax.jit
+    def run(x, idx):
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32)
+        )(x, idx)
+
+    try:
+        out = run(x, idx)
+        ref = np.take_along_axis(np.asarray(x), np.asarray(idx), axis=axis)
+        ok = bool((np.asarray(out) == ref).all())
+        msg = "OK" if ok else "WRONG RESULT"
+    except Exception as e:  # noqa: BLE001
+        print(f"rows={rows} axis={axis}: FAIL {type(e).__name__}: {str(e)[:200]}")
+        return None
+
+    # slope-time: loop the gather on device
+    mod = jnp.int32(hi)
+
+    def body(i, c):
+        g = run(x, (idx + i) % mod)
+        return c ^ jnp.sum(g, dtype=jnp.int32)
+
+    def wall(n):
+        f = jax.jit(lambda c: jax.lax.fori_loop(0, n, body, c))
+        r = f(jnp.int32(0))
+        _ = float(r)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = f(jnp.int32(0))
+            _ = float(r)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    n1, n2 = 4, 64
+    dt = (wall(n2) - wall(n1)) / (n2 - n1)
+    rate = rows * 128 / dt / 1e6
+    print(f"rows={rows} axis={axis}: {msg}  {dt*1e6:.0f} us/call  {rate:.0f} M elem/s")
+    return dt
+
+
+if __name__ == "__main__":
+    for axis in (0, 1):
+        for rows in (8, 64, 512, 2048, 8192):
+            try_shape(rows, axis)

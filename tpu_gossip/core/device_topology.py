@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_gossip.core.topology import Graph
+from tpu_gossip.core.topology import Graph, pareto_icdf
 
 __all__ = ["DeviceGraph", "device_powerlaw_graph", "truncated_pareto_mean"]
 
@@ -93,10 +93,8 @@ def truncated_pareto_mean(
     """E[min(floor(X), d_max)] for the inverse-CDF law used by
     ``powerlaw_degree_sequence`` (host twin: core/topology.py) — numeric
     host-side integral used to size the static stub budget."""
-    a = gamma - 1.0
-    lo, hi = float(d_min), float(d_max) + 1.0
     u = (np.arange(grid) + 0.5) / grid
-    x = (lo ** (-a) - u * (lo ** (-a) - hi ** (-a))) ** (-1.0 / a)
+    x = pareto_icdf(u, gamma, d_min, d_max)
     return float(np.minimum(np.floor(x), d_max).mean())
 
 
@@ -105,12 +103,10 @@ def truncated_pareto_mean(
 )
 def _build(key, *, n: int, gamma: float, d_min: int, d_max: int, s_cap: int):
     k_deg, k_pair = jax.random.split(key)
-    a = gamma - 1.0
-    lo, hi = float(d_min), float(d_max) + 1.0
 
     # --- degree sequence (inverse CDF of truncated Pareto, floored) -------
     u = jax.random.uniform(k_deg, (n,))
-    x = (lo ** (-a) - u * (lo ** (-a) - hi ** (-a))) ** (-1.0 / a)
+    x = pareto_icdf(u, gamma, d_min, d_max)
     deg = jnp.minimum(jnp.floor(x), float(d_max)).astype(jnp.int32)
 
     # clip the running total at an even budget <= s_cap (static shapes; the

@@ -1,0 +1,354 @@
+"""Structured-matching power-law topology: the gather-free graph family.
+
+This is the second device-native generator of the erased configuration
+model (the first, core/device_topology.py, pairs stubs with one argsort of
+random keys). Here the pairing permutation is CHOSEN to be a structured
+composition of per-row lane shuffles and transposes (kernels/permute.py) —
+the one data movement this chip does at streaming rate (see the measured
+numbers in that module's docstring). Because the matching IS the pipeline,
+a gossip round never gathers: sender words are class-broadcast onto stub
+slots, one pipeline application lands every word on its partner slot, and a
+class-reshape OR folds slots into receivers. At 1M peers that replaces the
+40 ms feed gather that bounds the staircase kernel path
+(docs/kernel_profile_1m.md) with ~1 ms of shuffle/transpose passes.
+
+Model semantics (matching device_powerlaw_graph up to documented deltas):
+
+- Degree law: the same truncated-Pareto inverse CDF (P(d) ~ d^-gamma on
+  [d_min, d_max]), evaluated at DETERMINISTIC quantiles u_i = (i+0.5)/n
+  instead of uniform draws. Every class boundary and slot offset is then a
+  static trace-time constant (no data-dependent shapes), and the degree
+  sequence is the law's exact quantile sequence; graph randomness comes
+  entirely from the pairing pipeline's random shuffle tables.
+- Stub layout: nodes relabelled degree-ascending and grouped into classes
+  of equal PADDED degree (host-planned runs, pad waste capped at a few
+  percent), each node owning ``pad_deg`` consecutive slots of which the
+  first ``deg`` are real. Node ids are therefore degree-sorted — documented,
+  and benchmarks seed origins at ids 0..m-1, i.e. minimum-degree nodes
+  (the median degree of a power-law swarm), which is the conservative side.
+- Pairing: slot j's partner is pi(j) for the involution
+  pi = L1·T·L2·T·M3·T^-1·L2^-1·T^-1·L1^-1 (M3 a per-row fixed-point-free
+  lane involution, L* random per-row lane permutations, T the transpose
+  bijection). pi has no fixed points, so every slot has a partner.
+- Erasure: a stub is erased when its partner is a padding slot, when the
+  pair is a self-loop, or when the (u, v) edge is a duplicate (plan-time
+  lexsort, exactly device_topology.py's rule) — both endpoints die, as in
+  the erased configuration model.
+
+The reference has no working graph builder at all (its powerlaw_connect is
+dead code with a negative-weight bug, reference Seed.py:151-185); this
+module and its two siblings implement the corrected semantics three ways
+(host numpy, device sort-based, device structured).
+
+Everything partner-related is computed by pushing plan vectors through the
+pipeline itself (owner ids, validity, degrees), so plan construction is as
+gather-free as the rounds it serves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.device_topology import DeviceGraph
+from tpu_gossip.core.topology import pareto_icdf
+from tpu_gossip.kernels.permute import apply_pipeline, inverse_tables
+from tpu_gossip.kernels.pallas_segment import bernoulli_threshold_device
+
+__all__ = ["MatchingPlan", "matching_powerlaw_graph", "quantile_degrees"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MatchingPlan:
+    """Static routing state for structured-matching delivery.
+
+    ``classes`` is a tuple of (node_off, slot_off, count, pad_deg) runs —
+    all Python ints, so expand/reduce slicing is static. Lane tables are
+    int32 (R, 128); ``valid`` marks slots that survived erasure (a live
+    directed edge owner(j) <- owner(pi(j))); thresholds are uint32 Bernoulli
+    gates exactly like StaircasePlan's (pallas_segment.py).
+    """
+
+    l1: jax.Array
+    l2: jax.Array
+    m3: jax.Array
+    l2i: jax.Array
+    l1i: jax.Array
+    valid: jax.Array  # bool (R, 128)
+    push_thresh: jax.Array | None  # uint32 (R, 128)
+    pull_thresh: jax.Array | None  # uint32 (R, 128)
+    deg_real: jax.Array | None = None  # int32 (n,) post-erasure degrees
+    n: int = dataclasses.field(default=0, metadata=dict(static=True))
+    rows: int = dataclasses.field(default=0, metadata=dict(static=True))
+    classes: tuple = dataclasses.field(default=(), metadata=dict(static=True))
+    fanout: int | None = dataclasses.field(default=None, metadata=dict(static=True))
+
+    def with_fanout(self, fanout: int, *, interpret: bool | None = None):
+        """Rebind the sampling thresholds for a different ``fanout`` without
+        rebuilding the graph (the pairing and erasure are fanout-free)."""
+        if self.deg_real is None:
+            raise ValueError("plan carries no realized degrees")
+        deg_self = self.expand(self.deg_real)
+        deg_other = self.partner(deg_self, interpret=interpret)
+        push = jnp.where(
+            self.valid & (deg_other > 0),
+            bernoulli_threshold_device(
+                fanout / jnp.maximum(deg_other, 1).astype(jnp.float32)
+            ),
+            jnp.uint32(0),
+        )
+        pull = jnp.where(
+            self.valid & (deg_self > 0),
+            bernoulli_threshold_device(
+                1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)
+            ),
+            jnp.uint32(0),
+        )
+        return dataclasses.replace(
+            self, push_thresh=push, pull_thresh=pull, fanout=fanout
+        )
+
+    @property
+    def stages(self) -> tuple:
+        """The pairing involution as a data-op pipeline (permute.py)."""
+        return (
+            ("lane", self.l1),
+            ("t",),
+            ("lane", self.l2),
+            ("t",),
+            ("lane", self.m3),
+            ("tinv",),
+            ("lane", self.l2i),
+            ("tinv",),
+            ("lane", self.l1i),
+        )
+
+    def partner(self, x: jax.Array, *, interpret: bool | None = None) -> jax.Array:
+        """out[j] = x[pi(j)] over (R, 128) slot data — ONE pipeline pass."""
+        return apply_pipeline(x, self.stages, interpret=interpret)
+
+    def expand(self, x_n: jax.Array) -> jax.Array:
+        """Broadcast per-node values (n,) onto slots (R, 128) — no gather."""
+        pieces = []
+        for node_off, _slot_off, count, pad_deg in self.classes:
+            pieces.append(
+                jnp.broadcast_to(
+                    jax.lax.dynamic_slice_in_dim(x_n, node_off, count)[:, None],
+                    (count, pad_deg),
+                ).reshape(-1)
+            )
+        flat = jnp.concatenate(pieces)
+        pad = self.rows * 128 - flat.shape[0]
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        return flat.reshape(self.rows, 128)
+
+    def reduce(self, slots: jax.Array, op: str = "or") -> jax.Array:
+        """Fold slot values (R, 128) into per-node values (n,) — no scatter.
+
+        ``op``: "or" (bitwise, delivery words) or "sum" (billing counts).
+        """
+        flat = slots.reshape(-1)
+        outs = []
+        for _node_off, slot_off, count, pad_deg in self.classes:
+            block = jax.lax.dynamic_slice_in_dim(
+                flat, slot_off, count * pad_deg
+            ).reshape(count, pad_deg)
+            if op == "or":
+                outs.append(jnp.bitwise_or.reduce(block, axis=1))
+            else:
+                outs.append(jnp.sum(block, axis=1, dtype=slots.dtype))
+        return jnp.concatenate(outs)
+
+
+def quantile_degrees(
+    n: int, gamma: float, d_min: int, d_max: int
+) -> np.ndarray:
+    """Ascending deterministic degree sequence: the shared truncated-Pareto
+    inverse CDF (topology.pareto_icdf) at quantiles (i+0.5)/n."""
+    u = (np.arange(n, dtype=np.float64) + 0.5) / n
+    x = pareto_icdf(u, gamma, d_min, d_max)
+    return np.minimum(np.floor(x), d_max).astype(np.int32)
+
+
+def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
+    """Greedy runs over the ascending degree sequence with pad_deg = run max
+    and max/min <= pad_ratio: static (node_off, slot_off, count, pad_deg)
+    tuples with total pad waste of a few percent."""
+    n = len(deg)
+    classes = []
+    i = 0
+    slot_off = 0
+    while i < n:
+        d0 = max(1, int(deg[i]))
+        limit = max(d0, int(d0 * pad_ratio))
+        j = int(np.searchsorted(deg, limit, side="right"))
+        j = max(j, i + 1)
+        pad_deg = max(1, int(deg[j - 1]))
+        classes.append((i, slot_off, j - i, pad_deg))
+        slot_off += (j - i) * pad_deg
+        i = j
+    return tuple(classes)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "rows", "classes", "fanout", "interpret")
+)
+def _build_plan(
+    key,
+    deg: jax.Array,
+    *,
+    n: int,
+    rows: int,
+    classes: tuple,
+    fanout: int | None,
+    interpret: bool | None,
+):
+    r = rows
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    # --- random stage tables --------------------------------------------
+    l1 = jnp.argsort(jax.random.uniform(k1, (r, 128)), axis=1).astype(jnp.int32)
+    l2 = jnp.argsort(jax.random.uniform(k2, (r, 128)), axis=1).astype(jnp.int32)
+    p = jnp.argsort(jax.random.uniform(k3, (r, 128)), axis=1).astype(jnp.int32)
+    a, b = p[:, 0::2], p[:, 1::2]
+    rows_ix = jnp.arange(r, dtype=jnp.int32)[:, None]
+    m3 = (
+        jnp.zeros((r, 128), jnp.int32)
+        .at[rows_ix, a]
+        .set(b)
+        .at[rows_ix, b]
+        .set(a)
+    )
+    l1i = inverse_tables(l1)
+    l2i = inverse_tables(l2)
+
+    plan0 = MatchingPlan(
+        l1=l1, l2=l2, m3=m3, l2i=l2i, l1i=l1i,
+        valid=jnp.zeros((r, 128), bool),
+        push_thresh=None, pull_thresh=None,
+        n=n, rows=r, classes=classes, fanout=None,
+    )
+
+    # --- per-slot plan vectors (owner, real-stub mask) -------------------
+    owner = plan0.expand(jnp.arange(n, dtype=jnp.int32))
+    sentinel_fill = jnp.arange(r * 128, dtype=jnp.int32).reshape(r, 128)
+    in_layout = sentinel_fill < sum(c * w for _, _, c, w in classes)
+    owner = jnp.where(in_layout, owner, n)  # tail pad -> sentinel
+    real = jnp.zeros((r * 128,), bool)
+    for node_off, slot_off, count, pad_deg in classes:
+        pos = jnp.arange(pad_deg, dtype=jnp.int32)[None, :]
+        d = jax.lax.dynamic_slice_in_dim(deg, node_off, count)[:, None]
+        real = jax.lax.dynamic_update_slice_in_dim(
+            real, (pos < d).reshape(-1), slot_off, axis=0
+        )
+    real = real.reshape(r, 128)
+
+    # --- partner-side quantities: ONE pipeline pass each ----------------
+    part = plan0.partner(sentinel_fill, interpret=interpret)  # pi as data
+    other_owner = plan0.partner(owner, interpret=interpret)
+    partner_real = plan0.partner(real.astype(jnp.int32), interpret=interpret) > 0
+
+    alive = real & partner_real & (other_owner != owner) & (other_owner < n)
+
+    # --- duplicate-edge erasure (device_topology.py:143-150's rule) ------
+    flat_id = sentinel_fill
+    canonical = alive & (flat_id < part)
+    ulo = jnp.where(canonical, jnp.minimum(owner, other_owner), n).reshape(-1)
+    uhi = jnp.where(canonical, jnp.maximum(owner, other_owner), n).reshape(-1)
+    order = jnp.lexsort((uhi, ulo))
+    slo, shi = ulo[order], uhi[order]
+    dup_sorted = jnp.zeros_like(slo, dtype=bool).at[1:].set(
+        (slo[1:] == slo[:-1]) & (shi[1:] == shi[:-1]) & (slo[1:] != n)
+    )
+    dup = (
+        jnp.zeros((r * 128,), bool)
+        .at[order]
+        .set(dup_sorted)
+        .reshape(r, 128)
+    )
+    dup_both = dup | (plan0.partner(dup.astype(jnp.int32), interpret=interpret) > 0)
+    valid = alive & ~dup_both
+
+    # --- realized degrees + thresholds ----------------------------------
+    deg_real = plan0.reduce(valid.astype(jnp.int32), op="sum")
+    push_thresh = pull_thresh = None
+    if fanout is not None:
+        deg_self = plan0.expand(deg_real)
+        deg_other = plan0.partner(deg_self, interpret=interpret)
+        push_thresh = jnp.where(
+            valid & (deg_other > 0),
+            bernoulli_threshold_device(fanout / jnp.maximum(deg_other, 1).astype(jnp.float32)),
+            jnp.uint32(0),
+        )
+        pull_thresh = jnp.where(
+            valid & (deg_self > 0),
+            bernoulli_threshold_device(1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)),
+            jnp.uint32(0),
+        )
+
+    # --- CSR export (sentinel-row form, device_topology.py:152-161) ------
+    src = jnp.where(valid, owner, n).reshape(-1)
+    dst = jnp.where(valid, other_owner, n).reshape(-1)
+    csr_order = jnp.argsort(src)
+    col_idx = dst[csr_order]
+    row_ptr = jnp.searchsorted(
+        src[csr_order], jnp.arange(n + 2, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    exists = jnp.arange(n + 1, dtype=jnp.int32) < n
+
+    return (
+        l1, l2, m3, l2i, l1i, valid, push_thresh, pull_thresh, deg_real,
+        row_ptr, col_idx, exists,
+    )
+
+
+def matching_powerlaw_graph(
+    n: int,
+    gamma: float = 2.5,
+    d_min: int = 2,
+    d_max: int | None = None,
+    *,
+    fanout: int | None = None,
+    key: jax.Array | None = None,
+    interpret: bool | None = None,
+) -> tuple[DeviceGraph, MatchingPlan]:
+    """Build the structured-matching power-law swarm on device.
+
+    Returns ``(graph, plan)``: ``graph`` is a sentinel-row DeviceGraph (feed
+    to ``init_swarm`` exactly like device_powerlaw_graph's) and ``plan`` the
+    MatchingPlan whose pipeline delivers rounds gather-free
+    (kernels/matching.py). With ``fanout``, sampled-delivery thresholds are
+    precomputed (same law as build_staircase_plan's).
+    """
+    if key is None:
+        key = jax.random.key(0)
+    if d_max is None:
+        d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
+    deg_host = quantile_degrees(n, gamma, d_min, d_max)
+    classes = _plan_classes(deg_host)
+    n_slots = sum(c * w for _, _, c, w in classes)
+    # rows hug the real stub count (granularity 8 rows = 1024 slots): the
+    # dead tail pairs with real stubs and erases them, so it must stay tiny
+    rows = math.ceil(n_slots / (128 * 8)) * 8
+    deg = jnp.asarray(deg_host)
+    (
+        l1, l2, m3, l2i, l1i, valid, pth, qth, deg_real, row_ptr, col_idx,
+        exists,
+    ) = _build_plan(
+        key, deg, n=n, rows=rows, classes=classes, fanout=fanout,
+        interpret=interpret,
+    )
+    plan = MatchingPlan(
+        l1=l1, l2=l2, m3=m3, l2i=l2i, l1i=l1i, valid=valid,
+        push_thresh=pth, pull_thresh=qth, deg_real=deg_real,
+        n=n, rows=rows, classes=classes, fanout=fanout,
+    )
+    graph = DeviceGraph(row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n)
+    return graph, plan

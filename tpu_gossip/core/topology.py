@@ -73,6 +73,17 @@ class Graph:
         return self.col_idx[self.row_ptr[i] : self.row_ptr[i + 1]]
 
 
+def pareto_icdf(u, gamma: float, d_min: int, d_max: int):
+    """Truncated-Pareto inverse CDF on [d_min, d_max+1) — the ONE definition
+    of the degree law every generator shares (host sampler here, device
+    sort-based device_topology.py, device structured matching_topology.py).
+    Pure arithmetic: accepts numpy arrays or jax tracers alike.
+    """
+    a = gamma - 1.0
+    lo, hi = float(d_min), float(d_max) + 1.0
+    return (lo ** (-a) - u * (lo ** (-a) - hi ** (-a))) ** (-1.0 / a)
+
+
 def powerlaw_degree_sequence(
     n: int,
     gamma: float = 2.5,
@@ -93,11 +104,7 @@ def powerlaw_degree_sequence(
         # natural cutoff for scale-free nets: ~ n^(1/(gamma-1))
         d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
     u = rng.random(n)
-    a = gamma - 1.0  # Pareto tail index
-    lo = float(d_min)
-    hi = float(d_max) + 1.0
-    # inverse CDF of truncated Pareto on [lo, hi)
-    x = (lo ** (-a) - u * (lo ** (-a) - hi ** (-a))) ** (-1.0 / a)
+    x = pareto_icdf(u, gamma, d_min, d_max)
     deg = np.minimum(np.floor(x), d_max).astype(np.int64)
     if deg.sum() % 2 == 1:
         deg[int(np.argmin(deg))] += 1

@@ -1,0 +1,147 @@
+"""Gather-free gossip delivery over a structured-matching topology.
+
+The round's dissemination (reference Peer.py:395-408's per-socket send loop)
+becomes three streaming stages on a :class:`~tpu_gossip.core.
+matching_topology.MatchingPlan`:
+
+    expand   per-node packed words -> stub slots      (class broadcast)
+    partner  slot j <- word of owner(pi(j))           (shuffle/transpose
+                                                       pipeline, permute.py)
+    reduce   OR slots into receivers                  (class reshape)
+
+No gather, no scatter, no segment reduction — every pass runs at VPU or HBM
+streaming rate (see permute.py's measured numbers). Sampling semantics are
+the expected-``fanout`` Bernoulli-per-edge law shared by the staircase
+kernel (pallas_segment.segment_sampled) and the dist engine's bucketed
+exchange: per-slot uint32 thresholds gate each direction of every surviving
+edge, one independent draw per direction per round. ``msgs`` accounting
+matches segment_sampled's convention (delivered slot-bits per fired edge,
+plus one request per fired pull edge of a receptive puller).
+
+Interface mirrors ``segment_sampled``/``segment_or`` so the engine treats
+the two kernel families interchangeably (sim/engine.py _disseminate_local).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpu_gossip.core.matching_topology import MatchingPlan
+from tpu_gossip.kernels.pallas_segment import (
+    _slot_groups,
+    pack_words,
+    unpack_words,
+)
+
+__all__ = ["matching_flood", "matching_sampled"]
+
+
+def _pad_rows(x: jax.Array, n_state: int) -> jax.Array:
+    """Pad per-node results (n, m) up to the state's row count (sentinel
+    rows receive nothing)."""
+    pad = n_state - x.shape[0]
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+def matching_flood(
+    plan: MatchingPlan,
+    transmit: jax.Array,
+    m: int,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """incoming[i] = OR over neighbors j of transmit[j] — flood delivery.
+
+    Bit-exact vs ``kernels.gossip.flood_all`` on the plan's exported CSR
+    (parity-tested): the valid slot set IS the edge set.
+    """
+    n_state = transmit.shape[0]
+    outs = []
+    for lo, w in _slot_groups(m):
+        words = pack_words(transmit[: plan.n, lo : lo + w])
+        across = plan.partner(plan.expand(words), interpret=interpret)
+        across = jnp.where(plan.valid, across, 0)
+        outs.append(unpack_words(plan.reduce(across, "or"), w))
+    inc = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    return _pad_rows(inc, n_state)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("m", "do_push", "do_pull", "interpret")
+)
+def matching_sampled(
+    plan: MatchingPlan,
+    transmit: jax.Array,
+    answer: jax.Array | None,
+    m: int,
+    key: jax.Array,
+    *,
+    receptive_rows: jax.Array | None = None,
+    do_push: bool = True,
+    do_pull: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sampled (push / push-pull) delivery, gather-free.
+
+    Same contract as ``segment_sampled`` (which documents the semantics):
+    ``answer=None`` means the pull half answers with ``transmit``;
+    ``receptive_rows`` (n_state,) bool gates the pull half by the puller at
+    ROW level and zeroes non-receptive rows' deliveries; returns
+    ``(incoming (n_state, m) bool, msgs_sent int32 scalar)``. Edge-level
+    activation is drawn once and shared across 32-slot word groups.
+    """
+    if plan.push_thresh is None:
+        raise ValueError("plan built without fanout — no sampling thresholds")
+    n_state = transmit.shape[0]
+    shape = (plan.rows, 128)
+    k_push, k_pull = jax.random.split(key)
+    msgs = jnp.zeros((), jnp.int32)
+    rec_rows_n = rec_slots = None
+    if receptive_rows is not None:
+        rec_rows_n = receptive_rows[: plan.n]
+        rec_slots = plan.expand(rec_rows_n.astype(jnp.int32)) > 0
+    active_p = active_q = None
+    pull_bill = None
+    if do_push:
+        active_p = jax.random.bits(k_push, shape, jnp.uint32) < plan.push_thresh
+    if do_pull:
+        active_q = jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_thresh
+        pull_bill = active_q.astype(jnp.int32)
+    outs = []
+    for lo, w in _slot_groups(m):
+        tx_words = pack_words(transmit[: plan.n, lo : lo + w])
+        slot_tx = plan.partner(plan.expand(tx_words), interpret=interpret)
+        combined = jnp.zeros(shape, jnp.int32)
+        if do_push:
+            wp = jnp.where(active_p, slot_tx, 0)
+            combined = combined | wp
+            msgs = msgs + jnp.sum(jax.lax.population_count(wp), dtype=jnp.int32)
+        if do_pull:
+            slot_ans = (
+                slot_tx
+                if answer is None
+                else plan.partner(
+                    plan.expand(pack_words(answer[: plan.n, lo : lo + w])),
+                    interpret=interpret,
+                )
+            )
+            wq = jnp.where(active_q, slot_ans, 0)
+            combined = combined | wq
+            pull_bill = pull_bill + jax.lax.population_count(wq)
+        outs.append(unpack_words(plan.reduce(combined, "or"), w))
+    incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    if rec_rows_n is not None:
+        incoming = incoming & rec_rows_n[:, None]
+    if do_pull:
+        if rec_slots is not None:
+            pull_bill = jnp.where(rec_slots, pull_bill, 0)
+        msgs = msgs + jnp.sum(pull_bill, dtype=jnp.int32)
+    return _pad_rows(incoming, n_state), msgs

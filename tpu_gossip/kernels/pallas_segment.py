@@ -116,6 +116,21 @@ def _bernoulli_threshold(p: np.ndarray) -> np.ndarray:
     )
 
 
+def bernoulli_threshold_device(p: jax.Array) -> jax.Array:
+    """Device twin of :func:`_bernoulli_threshold`, in f32 (x64 is off):
+    thresholds agree with the host's f64 values to ~2^-24 relative — a
+    per-edge firing-probability perturbation of < 1e-7. The clamp must be
+    the largest f32 BELOW 2^32 (4294967040): f32 can't represent 2^32-1,
+    and converting an out-of-range float to uint32 is
+    implementation-defined in XLA (saturates here, poison under an fptoui
+    lowering elsewhere). Shared by every device plan builder — the two
+    kernel families' firing laws must never drift."""
+    return jnp.minimum(
+        jnp.ceil(jnp.clip(p, 0.0, 1.0) * jnp.float32(2.0**32)),
+        jnp.float32(4294967040.0),
+    ).astype(jnp.uint32)
+
+
 def build_staircase_plan(
     row_ptr: np.ndarray,
     col_idx: np.ndarray,
@@ -287,19 +302,7 @@ def _plan_tables_device(
 
     push_thresh = pull_thresh = None
     if fanout is not None:
-        def thresh(p):
-            # device twin of _bernoulli_threshold, in f32 (x64 is off):
-            # thresholds agree with the host's f64 values to ~2^-24 relative
-            # — a per-edge firing-probability perturbation of < 1e-7. The
-            # clamp must be the largest f32 BELOW 2^32 (4294967040): f32
-            # can't represent 2^32-1, and converting an out-of-range float
-            # to uint32 is implementation-defined in XLA (saturates here,
-            # poison under an fptoui lowering elsewhere).
-            return jnp.minimum(
-                jnp.ceil(jnp.clip(p, 0.0, 1.0) * jnp.float32(2.0**32)),
-                jnp.float32(4294967040.0),
-            ).astype(jnp.uint32)
-
+        thresh = bernoulli_threshold_device
         src_deg = jnp.where(valid, deg[col_idx[eidx_safe]], 0)
         dst_deg = jnp.where(valid, deg[edge_dst], 0)
         push_thresh = jnp.where(

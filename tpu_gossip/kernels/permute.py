@@ -1,0 +1,142 @@
+"""Structured-permutation passes: the gather-free data movement primitive.
+
+Measured reality on this chip (experiments/gather_probe.py, 2026-07-30):
+EVERY XLA-level gather shape — flat random, wide-slice two-step, tall
+``take_along_axis``, even a pure in-register lane shuffle — runs at the same
+~126M elements/s, because XLA:TPU lowers them all through one serialized
+gather path. That rate is what bounds the staircase kernel's feed (40 ms of
+a ~50 ms round at 1M peers, docs/kernel_profile_1m.md). Mosaic, by contrast,
+compiles ``take_along_axis`` to the hardware's vreg-local ``dynamic_gather``:
+strictly 8-wide on sublanes and 128-wide on lanes — useless as a general
+gather, but running at ~188 G elements/s (experiments/perm_pipeline_probe.py).
+
+This module turns that one fast primitive into bulk data movement: a
+*structured permutation* is a composition of
+
+- per-row lane shuffles (static (R,128) index tables, Pallas, VPU rate),
+- full-array transposes (XLA, HBM-bandwidth rate),
+
+which moves 8.4M int32 in ~0.4 ms — two orders of magnitude faster than any
+gather XLA will emit. The matching topology (core/matching_topology.py)
+CHOOSES its configuration-model stub pairing to be exactly such a
+composition, so gossip delivery needs no gather at all: the reference's
+per-socket send loop (reference Peer.py:395-408) becomes expand -> permute
+-> reduce, all at streaming rates.
+
+Row count is only required to be a multiple of 8 (one sublane tile): a
+non-multiple of :data:`BLOCK_ROWS` is handled as one full-grid call plus a
+single remainder block, so the stub array can hug the real stub count —
+padding slots pair with real stubs and erase them, so the dead tail must
+stay tiny (core/matching_topology.py sizes it at <= 1023 slots).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = [
+    "BLOCK_ROWS",
+    "lane_shuffle",
+    "transpose_pass",
+    "untranspose_pass",
+    "apply_pipeline",
+    "inverse_tables",
+]
+
+BLOCK_ROWS = 2048  # rows per Pallas grid step; R must be a multiple
+
+
+def _shuffle_kernel(x_ref, idx_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(
+        x_ref[:], idx_ref[:].astype(jnp.int32), axis=1
+    )
+
+
+def _shuffle_call(x, idx, rows, interpret):
+    return pl.pallas_call(
+        _shuffle_kernel,
+        grid=(x.shape[0] // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, 128), lambda j: (j, 0)),
+            pl.BlockSpec((rows, 128), lambda j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, 128), lambda j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, idx)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lane_shuffle(
+    x: jax.Array, idx: jax.Array, *, interpret: bool | None = None
+) -> jax.Array:
+    """out[r, l] = x[r, idx[r, l]] — per-row 128-lane shuffle, Pallas.
+
+    ``x`` (R, 128) int32, ``idx`` (R, 128) int32 with values in [0, 128);
+    R must be a multiple of 8. Full :data:`BLOCK_ROWS` blocks go through one
+    grid; a remainder tail (< BLOCK_ROWS rows) rides a second single-block
+    call. Runs at VPU rate (~188 G elem/s measured) — the pass the whole
+    permutation pipeline is built from.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    r = x.shape[0]
+    if r % 8 != 0:
+        raise ValueError(f"rows {r} not a multiple of 8")
+    idx = idx.astype(jnp.int32)
+    r0 = (r // BLOCK_ROWS) * BLOCK_ROWS
+    parts = []
+    if r0:
+        parts.append(_shuffle_call(x[:r0], idx[:r0], BLOCK_ROWS, interpret))
+    if r - r0:
+        parts.append(_shuffle_call(x[r0:], idx[r0:], r - r0, interpret))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+def transpose_pass(x: jax.Array) -> jax.Array:
+    """Slot bijection: flat slot r*128+l -> l*R + r, reshaped back (R, 128).
+
+    XLA transposes run at HBM bandwidth here (~2 TB/s effective measured),
+    so this is the cheap cross-row mixing stage between lane shuffles.
+    """
+    r = x.shape[0]
+    return x.T.reshape(r, 128)
+
+
+def untranspose_pass(x: jax.Array) -> jax.Array:
+    """Inverse of :func:`transpose_pass`."""
+    r = x.shape[0]
+    return x.reshape(128, r).T
+
+
+def inverse_tables(idx: jax.Array) -> jax.Array:
+    """Per-row inverse permutation table, plan-time."""
+    return jnp.argsort(idx.astype(jnp.int32), axis=1).astype(jnp.int32)
+
+
+def apply_pipeline(
+    x: jax.Array, stages: tuple, *, interpret: bool | None = None
+) -> jax.Array:
+    """Apply a permutation pipeline to slot data ``x`` (R, 128).
+
+    ``stages`` is a tuple of ("lane", table) / ("t",) / ("tinv",) entries,
+    applied left to right as DATA operations: a "lane" stage with table L
+    maps out[r, l] = in[r, L[r, l]]; "t"/"tinv" are the transpose bijections
+    above. The matching topology stores one pipeline whose composition IS
+    the stub pairing.
+    """
+    for stage in stages:
+        kind = stage[0]
+        if kind == "lane":
+            x = lane_shuffle(x, stage[1], interpret=interpret)
+        elif kind == "t":
+            x = transpose_pass(x)
+        elif kind == "tinv":
+            x = untranspose_pass(x)
+        else:  # pragma: no cover - plan construction bug
+            raise ValueError(f"unknown stage kind {kind!r}")
+    return x

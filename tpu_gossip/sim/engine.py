@@ -139,6 +139,8 @@ def _disseminate_local(
         and cfg.mode in ("push", "push_pull")
     )
     if sampled_kernel:
+        from tpu_gossip.core.matching_topology import MatchingPlan
+        from tpu_gossip.kernels.matching import matching_sampled
         from tpu_gossip.kernels.pallas_segment import segment_sampled
 
         if plan.fanout != cfg.fanout:
@@ -154,7 +156,10 @@ def _disseminate_local(
             if answer is not None:
                 answer = answer & ~state.rewired[:, None]
             rec_rows = rec_rows & ~state.rewired
-        incoming, msgs_sent = segment_sampled(
+        deliver = (
+            matching_sampled if isinstance(plan, MatchingPlan) else segment_sampled
+        )
+        incoming, msgs_sent = deliver(
             plan, tx, answer, cfg.msg_slots, k_push,
             receptive_rows=rec_rows,
             do_push=True, do_pull=(cfg.mode == "push_pull"),
@@ -211,9 +216,14 @@ def _disseminate_local(
         )
     if cfg.mode == "flood":
         if plan is not None:
+            from tpu_gossip.core.matching_topology import MatchingPlan
+            from tpu_gossip.kernels.matching import matching_flood
             from tpu_gossip.kernels.pallas_segment import segment_or
 
-            incoming = incoming | segment_or(plan, transmit, cfg.msg_slots)
+            if isinstance(plan, MatchingPlan):
+                incoming = incoming | matching_flood(plan, transmit, cfg.msg_slots)
+            else:
+                incoming = incoming | segment_or(plan, transmit, cfg.msg_slots)
         else:
             incoming = incoming | flood_all(transmit, state.row_ptr, state.col_idx)
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
