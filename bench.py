@@ -14,14 +14,16 @@ Metric per BASELINE.json: rounds-to-99%-coverage and peers·rounds/sec on a
 Runs are single on-device while_loops (compile + warmup excluded; min wall
 over 3 reps because the axon tunnel has high run-to-run variance).
 
-Every dissemination config is measured over BOTH delivery paths —
-``xla`` (gather + serialized `.at[].max` scatter, kernels/gossip.py) and
+Every dissemination config is measured over THREE delivery paths —
+``xla`` (gather + serialized `.at[].max` scatter, kernels/gossip.py),
 ``pallas`` (the staircase MXU kernel, kernels/pallas_segment.py: flood via
-``segment_or``, push/push-pull via ``segment_sampled`` — the north star's
-"single Pallas segment-scatter kernel" replacing the reference's per-socket
-send loop, reference Peer.py:395-408). The headline number is the faster
-path; both appear under ``configs`` so the comparison is reproducible from
-this artifact alone.
+``segment_or``, push/push-pull via ``segment_sampled`` — replacing the
+reference's per-socket send loop, reference Peer.py:395-408), and
+``matching`` (the gather-free structured-matching pipeline,
+core/matching_topology.py + kernels/matching.py, measured on its own
+generator of the same erased-configuration-model family). The headline
+number is the fastest path; all appear under ``configs`` so the comparison
+is reproducible from this artifact alone.
 
 Headline configs run ``msg_slots=16`` with one rumor seeded per slot
 (``init_swarm(origin_slots=...)``) so the dedup bitmap, packing, and (N, M)
@@ -174,6 +176,24 @@ def _build_plan(dg, fanout, rows, device=False):
     return plan, time.perf_counter() - t0
 
 
+def _build_matching(n: int, fanout: int, key_i: int = 0):
+    """Structured-matching graph + plan (its own generator — the pairing IS
+    the delivery plan, so one build covers both). Returns
+    ``(graph, plan, build_seconds)``; the barrier is a host scalar fetch
+    (axon's block_until_ready can return early)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+
+    t0 = time.perf_counter()
+    graph, plan = matching_powerlaw_graph(
+        n, gamma=2.5, fanout=fanout, key=jax.random.key(key_i)
+    )
+    int(jnp.sum(plan.valid))
+    return graph, plan, time.perf_counter() - t0
+
+
 def bench_one(
     dg,
     mode: str,
@@ -188,6 +208,7 @@ def bench_one(
     import jax
     import numpy as np
 
+    from tpu_gossip.core.matching_topology import MatchingPlan
     from tpu_gossip.core.state import SwarmConfig, init_swarm
     from tpu_gossip.sim.metrics import bench_swarm
 
@@ -205,16 +226,22 @@ def bench_one(
     )
     res, _ = bench_swarm(state, cfg, 0.99, max_rounds, reps=reps, plan=plan)
     acc = _accesses_per_round(cfg, int(dg.col_idx.shape[0]))
+    if plan is None:
+        delivery = "xla"
+    elif isinstance(plan, MatchingPlan):
+        delivery = "matching"
+    else:
+        delivery = "pallas"
     out = {
         **{k: (round(v, 4) if isinstance(v, float) else v)
            for k, v in dataclasses.asdict(res).items()},
         "msg_slots": msg_slots,
-        "delivery": "pallas" if plan is not None else "xla",
+        "delivery": delivery,
         "accesses_per_round_M": round(acc / 1e6, 2),
     }
     if plan is not None:
-        # the staircase kernel streams edge tiles through the MXU — random
-        # access is not its binding resource, so no utilization rate here
+        # the kernel paths stream tiles/slots — random access is not their
+        # binding resource, so no utilization rate here
         out["plan_rows"] = plan.rows
     else:
         out["access_rate_per_sec_M"] = round(
@@ -446,27 +473,51 @@ def main(argv: list[str] | None = None) -> int:
     plan1_k3, plan1_k3_s = (None, 0.0) if quick else _build_plan(dg1, fanout=3, rows=1024)
     plan1_fl, plan1_fl_s = (None, 0.0) if quick else _build_plan(dg1, fanout=None, rows=1024)
 
-    # --- 1M standard configs, both delivery paths ------------------------
+    # structured-matching twin: its own generator (same erased-configuration
+    # model family, deterministic quantile degrees), whose pairing IS the
+    # delivery plan — the gather-free path (core/matching_topology.py)
+    mg1, mplan1, match1_s = _build_matching(1_000_000, fanout=1)
+
+    # --- 1M standard configs, all delivery paths -------------------------
     hl_xla = bench_one(dg1, "push_pull", 1, msg_slots=16, reps=reps)
     hl_pal = bench_one(dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1)
-    headline = min(hl_xla, hl_pal, key=lambda r: r["wall_seconds"])
+    hl_match = bench_one(mg1, "push_pull", 1, msg_slots=16, reps=reps, plan=mplan1)
+    headline = min(hl_xla, hl_pal, hl_match, key=lambda r: r["wall_seconds"])
 
     configs = {
         "push_pull_k1_m16_xla": hl_xla,
         "push_pull_k1_m16_pallas": hl_pal,
+        "push_pull_k1_m16_matching": hl_match,
         # historical msg_slots=1 shape (cross-round comparability with r01/r02)
         "push_pull_k1_m1_xla": bench_one(dg1, "push_pull", 1, msg_slots=1, reps=reps),
     }
     if not quick:
+        # 64-slot headline shape (VERDICT r4 item 8): two word groups, the
+        # multi-word path unit tests exercise, now measured at scale
+        configs["push_pull_k1_m64_xla"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=64, reps=reps
+        )
+        configs["push_pull_k1_m64_pallas"] = bench_one(
+            dg1, "push_pull", 1, msg_slots=64, reps=reps, plan=plan1_k1
+        )
+        configs["push_pull_k1_m64_matching"] = bench_one(
+            mg1, "push_pull", 1, msg_slots=64, reps=reps, plan=mplan1
+        )
         configs["push_k3_m16_xla"] = bench_one(dg1, "push", 3, msg_slots=16, reps=reps)
         configs["push_k3_m16_pallas"] = bench_one(
             dg1, "push", 3, msg_slots=16, reps=reps, plan=plan1_k3
+        )
+        configs["push_k3_m16_matching"] = bench_one(
+            mg1, "push", 3, msg_slots=16, reps=reps, plan=mplan1.with_fanout(3)
         )
         # flood: the staircase kernel's original formulation, both paths
         # (VERDICT r2 item 3: the kernel's win must live in this artifact)
         configs["flood_m16_xla"] = bench_one(dg1, "flood", 1, msg_slots=16, reps=reps)
         configs["flood_m16_pallas"] = bench_one(
             dg1, "flood", 1, msg_slots=16, reps=reps, plan=plan1_fl
+        )
+        configs["flood_m16_matching"] = bench_one(
+            mg1, "flood", 1, msg_slots=16, reps=reps, plan=mplan1
         )
         # BASELINE config 4: 1M SIR epidemic (per-slot recovery 8 rounds
         # after infection; coverage counts seen-ever, so the target stays
@@ -481,6 +532,10 @@ def main(argv: list[str] | None = None) -> int:
         configs["sir_1m_push_pull_m16_pallas"] = bench_one(
             dg1, "push_pull", 1, msg_slots=16, reps=reps, sir_recover_rounds=8,
             plan=plan1_k1,
+        )
+        configs["sir_1m_push_pull_m16_matching"] = bench_one(
+            mg1, "push_pull", 1, msg_slots=16, reps=reps, sir_recover_rounds=8,
+            plan=mplan1,
         )
         # BASELINE config 5: 1M dynamic Poisson churn with power-law
         # re-wiring (rejoiners attach 2 fresh degree-preferential edges),
@@ -506,6 +561,12 @@ def main(argv: list[str] | None = None) -> int:
             dg1, "push_pull", 1, msg_slots=16, reps=reps, plan=plan1_k1,
             rewire_compact_cap=65536, **churn_kw,
         )
+        # config 5 over the matching path: the gather-free bulk plus the
+        # same compact fresh-edge side paths (which draw on the exported CSR)
+        configs["churn_rewire_1m_compact_matching"] = bench_one(
+            mg1, "push_pull", 1, msg_slots=16, reps=reps, plan=mplan1,
+            rewire_compact_cap=65536, **churn_kw,
+        )
         # config 5 + periodic re-materialization (topology lifecycle; see
         # bench_churn_remat's docstring for why this is NOT a rate win)
         configs["churn_rewire_1m_remat16"] = bench_churn_remat(dg1, reps=reps)
@@ -516,8 +577,11 @@ def main(argv: list[str] | None = None) -> int:
     if profile_dir:
         # one warmed headline rep under the device tracer (SURVEY.md §5.1)
         with trace(profile_dir):
-            bench_one(dg1, "push_pull", 1, msg_slots=16, reps=1,
-                      plan=plan1_k1 if headline is hl_pal else None)
+            if headline is hl_match:
+                bench_one(mg1, "push_pull", 1, msg_slots=16, reps=1, plan=mplan1)
+            else:
+                bench_one(dg1, "push_pull", 1, msg_slots=16, reps=1,
+                          plan=plan1_k1 if headline is hl_pal else None)
 
     out = {
         "metric": "1M-node power-law (gamma=2.5) push-pull gossip to 99% coverage",
@@ -529,9 +593,12 @@ def main(argv: list[str] | None = None) -> int:
         "headline_delivery": headline["delivery"],
         "setup_seconds_1m": round(setup_1m, 2),
         "plan_build_seconds_1m": round(plan1_k1_s + plan1_k3_s + plan1_fl_s, 2),
+        "matching_build_seconds_1m": round(match1_s, 2),
         "configs": configs,
         "hardware_ceilings": ceilings,
-        "graph": "on-device erased configuration model (core/device_topology.py)",
+        "graph": "on-device erased configuration model (core/device_topology.py"
+        " for xla/pallas; structured-matching twin core/matching_topology.py"
+        " for matching configs)",
         # entry count + jax version, not a bald warm/cold claim: cache keys
         # include the jaxlib version, so entries can be present yet stale
         "compilation_cache": {
@@ -580,27 +647,53 @@ def main(argv: list[str] | None = None) -> int:
             "plan_build_seconds": round(plan10_fl_s, 2),
         }
         del plan10_fl
+        # structured-matching at north-star scale: its build replaces BOTH
+        # the CSR graph build and the plan build (the pairing is the plan),
+        # so its end-to-end charge is just build_warm + sim wall. Cold vs
+        # warm mirrors the setup accounting above.
+        mg10, mplan10, match10_cold_s = _build_matching(10_000_000, 1, key_i=0)
+        del mg10, mplan10
+        mg10, mplan10, match10_s = _build_matching(10_000_000, 1, key_i=1)
+        ns_match = bench_one(
+            mg10, "push_pull", 1, msg_slots=16, reps=reps, plan=mplan10
+        )
+        flood10["matching"] = bench_one(
+            mg10, "flood", 1, msg_slots=16, reps=1, max_rounds=50, plan=mplan10
+        )
+        del mg10, mplan10
         # end-to-end cost per path: each path is charged EVERYTHING it needs
         # beyond the warm graph build — the pallas path needs its staircase
-        # plan, the xla path needs nothing extra — so 'met' can't hide a
+        # plan, the xla path needs nothing extra, the matching path charges
+        # its whole build (graph included) — so 'met' can't hide a
         # 90 s plan build behind a marginally faster sim wall
         e2e_xla = setup_warm + ns_xla["wall_seconds"]
         e2e_pal = setup_warm + plan10_s + ns_pal["wall_seconds"]
-        ns = ns_xla if e2e_xla <= e2e_pal else ns_pal
+        e2e_match = match10_s + ns_match["wall_seconds"]
+        ns = min(
+            (e2e_xla, ns_xla), (e2e_pal, ns_pal), (e2e_match, ns_match),
+            key=lambda t: t[0],
+        )[1]
         out["north_star"] = {
             **ns,
             "xla": {**ns_xla, "end_to_end_seconds": round(e2e_xla, 2)},
             "pallas": {**ns_pal, "end_to_end_seconds": round(e2e_pal, 2)},
+            "matching": {**ns_match, "end_to_end_seconds": round(e2e_match, 2)},
             "setup_seconds_cold": round(setup_cold, 2),
             "setup_seconds_warm": round(setup_warm, 2),
             "plan_build_seconds": round(plan10_s, 2),
             "plan_build_seconds_cold": round(plan10_cold_s, 2),
+            "matching_build_seconds": round(match10_s, 2),
+            "matching_build_seconds_cold": round(match10_cold_s, 2),
             "target": "10M peers to 99% < 60 s (BASELINE.json north_star)",
-            "met_definition": "min over delivery paths of (setup_seconds_warm "
-            "+ path-specific prep, measured warm like setup + sim "
-            "wall_seconds) < 60",
-            "met_sim_only": bool(min(ns_xla["wall_seconds"], ns_pal["wall_seconds"]) < 60.0),
-            "met": bool(min(e2e_xla, e2e_pal) < 60.0),
+            "met_definition": "min over delivery paths of (path-specific "
+            "warm setup + prep + sim wall_seconds) < 60",
+            "met_sim_only": bool(
+                min(
+                    ns_xla["wall_seconds"], ns_pal["wall_seconds"],
+                    ns_match["wall_seconds"],
+                ) < 60.0
+            ),
+            "met": bool(min(e2e_xla, e2e_pal, e2e_match) < 60.0),
             "flood_10m": flood10,
         }
 
@@ -645,16 +738,18 @@ def _compact(out: dict) -> dict:
     }
     ns = out.get("north_star")
     if ns:
+        paths = tuple(p for p in ("xla", "pallas", "matching") if p in ns)
         compact["north_star"] = {
             "met": ns["met"],
             "met_sim_only": ns["met_sim_only"],
             "best_delivery": ns["delivery"],
             "end_to_end_seconds": {
-                p: ns[p]["end_to_end_seconds"] for p in ("xla", "pallas")
+                p: ns[p]["end_to_end_seconds"] for p in paths
             },
-            "ms_per_round": {p: ns[p]["ms_per_round"] for p in ("xla", "pallas")},
+            "ms_per_round": {p: ns[p]["ms_per_round"] for p in paths},
             "flood_ms_per_round": {
-                p: ns["flood_10m"][p]["ms_per_round"] for p in ("xla", "pallas")
+                p: ns["flood_10m"][p]["ms_per_round"]
+                for p in paths if p in ns["flood_10m"]
             },
         }
     dist = out.get("dist")

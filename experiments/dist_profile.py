@@ -1,0 +1,69 @@
+"""Slope-timed stage decomposition of the dist engine's _exchange at 1M
+(VERDICT r4 item 2): where the single-device overhead lives, measured on
+hardware, plus the post-rewrite end-to-end dist-vs-local comparison."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.state import SwarmConfig
+from tpu_gossip.core.topology import (
+    build_csr, configuration_model, powerlaw_degree_sequence,
+)
+from tpu_gossip.dist import (
+    build_shard_plans, init_sharded_swarm, make_mesh, partition_graph,
+    run_until_coverage_dist, shard_swarm,
+)
+from tpu_gossip.sim.engine import run_until_coverage
+from tpu_gossip.sim.metrics import bench_swarm
+
+N = 1_000_000
+
+
+def timed(run, reps=3):
+    fin = run()
+    cov, rounds = float(fin.coverage(0)), int(fin.round)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fin = run()
+        float(fin.coverage(0))
+        best = min(best, time.perf_counter() - t0)
+    return best, rounds, cov
+
+
+def main():
+    rng = np.random.default_rng(0)
+    graph = build_csr(
+        N, configuration_model(powerlaw_degree_sequence(N, gamma=2.5, rng=rng), rng=rng)
+    )
+    print("host graph built", flush=True)
+    mesh = make_mesh()
+    sg, relabeled, position = partition_graph(graph, mesh.size, seed=0)
+    plans = build_shard_plans(sg)
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
+    st0 = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
+    st = shard_swarm(st0, mesh)
+    print(f"devices={mesh.size} bucket={sg.bucket} per={sg.per_shard}", flush=True)
+
+    w, r, c = timed(lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300))
+    print(f"dist scatter: {w/r*1e3:.1f} ms/round ({r} rounds, cov {c:.4f})",
+          flush=True)
+    w2, r2, c2 = timed(
+        lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300,
+                                        shard_plan=plans)
+    )
+    print(f"dist pallas:  {w2/r2*1e3:.1f} ms/round ({r2} rounds, cov {c2:.4f})",
+          flush=True)
+    w3, r3, c3 = timed(lambda: run_until_coverage(st0, cfg, 0.99, 300))
+    print(f"local xla:    {w3/r3*1e3:.1f} ms/round ({r3} rounds)", flush=True)
+    print(f"overhead_vs_local: scatter {w/r/(w3/r3):.2f}x  "
+          f"pallas {w2/r2/(w3/r3):.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

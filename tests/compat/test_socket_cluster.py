@@ -266,3 +266,35 @@ async def test_seed_mesh_survives_hung_and_hostile_config_entries(tmp_path):
                 await asyncio.wait_for(srv.wait_closed(), timeout=5)
             except (asyncio.TimeoutError, TimeoutError):
                 pass  # teardown is best-effort; never hang the suite
+
+
+@asyncio_test
+async def test_stdin_passthrough_reaches_seeds(tmp_path):
+    """The reference forwards unrecognized stdin lines to every seed
+    (Peer.py:441-442); the seed logs them as unrecognized traffic
+    (Seed.py:440-441 counterpart: our seed logs the raw line)."""
+    seeds, peers = await start_cluster(tmp_path, n_seeds=2, n_peers=1)
+    try:
+        n = peers[0].send_to_seeds("operator note: hello")
+        assert n == len(peers[0].seed_writers) >= 1
+        await asyncio.sleep(TIMING.seed_reconnect_period)
+    finally:
+        await stop_all(seeds, peers)
+    # the line reached at least one seed's log as unrecognized/raw traffic
+    logged = ""
+    for f in tmp_path.glob("seed_log_*"):
+        logged += f.read_text()
+    assert "operator note: hello" in logged
+
+
+@asyncio_test
+async def test_peer_connection_dump_lists_neighbors(tmp_path):
+    seeds, peers = await start_cluster(tmp_path, n_seeds=1, n_peers=3)
+    try:
+        dumps = [p.neighbors for p in peers]
+        assert any(len(d) > 0 for d in dumps)
+        for d in dumps:
+            for addr in d:
+                assert isinstance(addr, tuple) and len(addr) == 2
+    finally:
+        await stop_all(seeds, peers)

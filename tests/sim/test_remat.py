@@ -2,7 +2,9 @@
 rebuild): edge algebra, parity with a from-scratch CSR build, tail-handling
 on both delivery paths, overflow clipping, and steady-state churn use."""
 
+import contextlib
 import dataclasses
+import io
 
 import jax
 import jax.numpy as jnp
@@ -231,3 +233,27 @@ def test_remat_identity_when_nothing_rewired(mode):
         np.testing.assert_array_equal(
             np.sort(a[rp[i]:rp[i+1]]), np.sort(b[rp[i]:rp[i+1]]), err_msg=str(i)
         )
+
+def test_cli_shard_epoch_loop_runs_churn_remat_repartition():
+    """VERDICT r4 item 3: the full churn -> remat -> repartition -> continue
+    epoch loop through the CLI path, on the 8-device CPU mesh, both receive
+    paths (scatter and per-shard staircase kernel)."""
+    import json
+
+    from tpu_gossip.cli.run_sim import main
+
+    for extra in ([], ["--staircase"]):
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = main([
+                "--peers", "600", "--graph", "chung-lu", "--mode", "push_pull",
+                "--fanout", "1", "--slots", "4", "--shard",
+                "--churn-leave", "0.01", "--churn-join", "0.05",
+                "--rewire-slots", "2", "--remat-every", "4",
+                "--rounds", "12", "--quiet", "--seed", "3",
+            ] + extra)
+        assert rc == 0
+        summary = json.loads(out.getvalue().strip().splitlines()[-1])
+        assert summary["remats"] >= 2  # the epoch loop actually cycled
+        assert summary["devices"] == 8
+        assert summary["rounds_run"] == 12

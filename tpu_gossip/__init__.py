@@ -30,6 +30,10 @@ from tpu_gossip.core.topology import (
     fit_powerlaw_gamma,
 )
 from tpu_gossip.core.state import SwarmState, SwarmConfig, init_swarm
+from tpu_gossip.core.matching_topology import (
+    MatchingPlan,
+    matching_powerlaw_graph,
+)
 
 __version__ = "0.1.0"
 
@@ -43,4 +47,6 @@ __all__ = [
     "SwarmState",
     "SwarmConfig",
     "init_swarm",
+    "MatchingPlan",
+    "matching_powerlaw_graph",
 ]

@@ -1,8 +1,11 @@
 """Peer node CLI (reference: ``python Peer.py`` + stdin port prompt,
 Peer.py:456-465). The reference operator surface is preserved on stdin:
-``exit`` quits, ``1`` toggles silent-mode fault injection (Peer.py:437-439),
-any other line is gossiped into the swarm (generalized from the reference's
-forward-to-seeds passthrough, Peer.py:441-442).
+``exit`` quits, ``1`` toggles silent-mode fault injection (Peer.py:437-439);
+any other line is gossiped into the swarm (a generalization), or — with
+``--stdin-to-seeds`` — forwarded verbatim to every connected seed, the
+reference's literal passthrough (Peer.py:441-442, consumed as
+"Unrecognized" at Seed.py:440-441). ``--dump-every`` prints the live
+connection list periodically (printPeerConnections, Peer.py:448-454).
 """
 
 from __future__ import annotations
@@ -26,6 +29,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--run-seconds", type=float, default=0,
                    help="run this long then exit (0 = until stdin 'exit'; "
                    "EOF on stdin leaves the node running as a daemon)")
+    p.add_argument("--stdin-to-seeds", action="store_true",
+                   help="forward unrecognized stdin lines to every connected "
+                   "seed (the reference's literal passthrough, "
+                   "Peer.py:441-442) instead of gossiping them")
+    p.add_argument("--dump-every", type=float, default=0, metavar="SECONDS",
+                   help="periodically print this peer's live connections "
+                   "(printPeerConnections, Peer.py:448-454); 0 = off")
     return p
 
 
@@ -59,9 +69,21 @@ async def amain(args) -> int:
                 node.set_silent(not node.silent)
                 node.log(f"silent={node.silent}")
             elif line.strip():
-                node.gossip(line.strip())
+                if args.stdin_to_seeds:
+                    n = node.send_to_seeds(line.strip())
+                    node.log(f"forwarded to {n} seeds: {line.strip()!r}")
+                else:
+                    node.gossip(line.strip())
+
+    async def dump_loop():
+        while node.running:
+            await asyncio.sleep(args.dump_every)
+            if node.running:
+                node.log(f"connections: {node.neighbors}")
 
     asyncio.ensure_future(stdin_loop())
+    if args.dump_every > 0:
+        asyncio.ensure_future(dump_loop())
     if args.run_seconds > 0:
         await asyncio.sleep(args.run_seconds)
         await node.stop()

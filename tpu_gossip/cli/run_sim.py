@@ -24,10 +24,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--peers", type=int, default=1000, help="swarm size N")
     p.add_argument(
         "--graph",
-        choices=["pa", "chung-lu"],
+        choices=["pa", "chung-lu", "matching"],
         default="pa",
         help="pa: preferential attachment (Barabási–Albert); "
-        "chung-lu: configuration model with P(d)~d^-gamma",
+        "chung-lu: configuration model with P(d)~d^-gamma; "
+        "matching: structured-matching erased configuration model "
+        "(device-built, gather-free delivery — the fastest path; "
+        "local engine only)",
     )
     p.add_argument("--gamma", type=float, default=2.5, help="power-law exponent (chung-lu)")
     p.add_argument("--m", type=int, default=3, help="edges per new node (pa)")
@@ -70,8 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="every R rounds, fold rejoiners' fresh edges into the CSR and "
         "clear the rewired set (sim.engine.rematerialize_rewired) — churn "
         "rounds then run at static-topology cost between rebuilds; with "
-        "--staircase the plan is rebuilt per segment (0 = off; local "
-        "engine only)",
+        "--staircase the plan is rebuilt per segment; with --shard the "
+        "fold is followed by a full epoch re-partition onto the mesh "
+        "(dist.repartition_swarm: fresh bucket tables + shard plans), so "
+        "the rewired set stays bounded — pair with --rewire-compact-cap "
+        "(0 = off)",
     )
     p.add_argument(
         "--shard",
@@ -93,11 +99,6 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.remat_every > 0 and args.shard:
-        # reject before the (potentially minutes-long) host graph build
-        print("--remat-every is local-engine only: the dist engine's bucket "
-              "tables are static per partition", file=sys.stderr)
-        return 2
 
     import jax
 
@@ -107,18 +108,34 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_gossip.sim.engine import simulate
 
     rng = np.random.default_rng(args.seed)
-    if args.graph == "pa":
+    mplan = exists = None
+    if args.graph == "matching":
+        if args.shard or args.remat_every > 0:
+            print("--graph matching is local-engine only (its pairing IS the "
+                  "delivery plan; no CSR re-materialization applies)",
+                  file=sys.stderr)
+            return 2
+        from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+
+        dgraph, mplan = matching_powerlaw_graph(
+            args.peers, gamma=args.gamma,
+            fanout=None if args.mode == "flood" else args.fanout,
+            key=jax.random.key(args.seed),
+        )
+        graph, exists = dgraph.as_padded_graph(), dgraph.exists
+    elif args.graph == "pa":
         edges = topology.preferential_attachment(args.peers, m=args.m, rng=rng)
+        graph = topology.build_csr(args.peers, edges)
     else:
         deg = topology.powerlaw_degree_sequence(args.peers, gamma=args.gamma, rng=rng)
         edges = topology.configuration_model(deg, rng=rng)
-    graph = topology.build_csr(args.peers, edges)
+        graph = topology.build_csr(args.peers, edges)
 
     if args.shard:
         return _main_shard(args, graph, rng)
 
     cfg = SwarmConfig(
-        n_peers=args.peers,
+        n_peers=graph.n,
         msg_slots=args.slots,
         fanout=args.fanout,
         mode=args.mode,
@@ -129,8 +146,11 @@ def main(argv: list[str] | None = None) -> int:
         rewire_slots=args.rewire_slots,
         rewire_compact_cap=args.rewire_compact_cap,
     )
-    plan = None
-    if args.staircase and args.remat_every == 0:
+    plan = mplan
+    if mplan is not None and args.staircase:
+        print("note: --staircase is ignored with --graph matching (the "
+              "matching pipeline IS the delivery plan)", file=sys.stderr)
+    if mplan is None and args.staircase and args.remat_every == 0:
         # (with --remat-every the plan is rebuilt per segment instead)
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
@@ -142,7 +162,10 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     origins, silent_ids = _sample_ids(args, rng)
-    state = init_swarm(graph, cfg, key=jax.random.key(args.seed), origins=origins)
+    state = init_swarm(
+        graph, cfg, key=jax.random.key(args.seed), origins=origins,
+        exists=exists,
+    )
     if silent_ids is not None:
         state.silent = state.silent.at[silent_ids].set(True)
 
@@ -199,6 +222,20 @@ def _run_with_remat(args, cfg, state):
             np.asarray(state.row_ptr), np.asarray(state.col_idx),
             fanout=None if args.mode == "flood" else args.fanout,
         )
+
+    # warm the first segment's compiles OUTSIDE the timed region (same
+    # static shapes as the loop body) so the summary's ms_per_round is
+    # comparable with bench_swarm's compile-excluded figures (the remat
+    # compile still lands inside — it only exists on this path and is part
+    # of its cost)
+    warm_plan = seg_plan()
+    seg0 = min(r, total - int(state.round))
+    if args.rounds > 0:
+        warm = simulate(state, cfg, seg0, warm_plan)[0]
+    else:
+        warm = run_until_coverage(state, cfg, args.target, seg0, plan=warm_plan)
+    float(warm.coverage(0))  # fetch = completion barrier on axon
+    del warm, warm_plan
 
     t0 = _time.perf_counter()
     while int(state.round) < total:
@@ -269,6 +306,104 @@ def _horizon_summary(args, stats, **extra):
     }
 
 
+def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
+    """The mesh epoch loop (SURVEY.md §7.4's full churn lifecycle):
+
+        R churned rounds -> fold fresh edges into the CSR
+        (sim.engine.rematerialize_rewired) -> re-partition the LIVE swarm
+        onto the mesh (dist.repartition_swarm: fresh bucket tables, state
+        remapped through the new load-balance permutation) -> rebuild the
+        per-shard staircase plans if --staircase -> continue.
+
+    Between rebuilds every round runs at static-topology cost with a
+    bounded rewired set. ms_per_round excludes the first segment's compile
+    (warmed below); the per-epoch rebuild cost is reported separately AND
+    folded into the amortized figure.
+    """
+    import time as _time
+
+    import jax
+
+    from tpu_gossip.dist import (
+        build_shard_plans, repartition_swarm, run_until_coverage_dist,
+        shard_swarm, simulate_dist,
+    )
+    from tpu_gossip.sim import metrics as M
+    from tpu_gossip.sim.engine import remat_capacity, rematerialize_rewired
+
+    r = args.remat_every
+    total = args.rounds if args.rounds > 0 else args.max_rounds
+    remats = 0
+    overflow_total = 0
+    rebuild_s = 0.0
+    stats_parts = []
+
+    # warm the first segment outside the timed region (same static shapes)
+    seg0 = min(r, total)
+    if args.rounds > 0:
+        warm = simulate_dist(state, cfg, sg, mesh, seg0, plans)[0]
+    else:
+        warm = run_until_coverage_dist(
+            state, cfg, sg, mesh, args.target, seg0, shard_plan=plans
+        )
+    float(warm.coverage(0))
+    del warm
+
+    t0 = _time.perf_counter()
+    while int(state.round) < total:
+        seg = min(r, total - int(state.round))
+        if args.rounds > 0:
+            state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans)
+            stats_parts.append(stats)
+        else:
+            state = run_until_coverage_dist(
+                state, cfg, sg, mesh, args.target, seg, shard_plan=plans
+            )
+            if float(state.coverage(0)) >= args.target:
+                break
+        if int(state.round) < total:
+            tr = _time.perf_counter()
+            cap = remat_capacity(state, cfg)
+            state, overflow = rematerialize_rewired(state, cfg, cap)
+            sg, state, _position = repartition_swarm(
+                state, mesh.size, seed=args.seed + remats + 1
+            )
+            state = shard_swarm(state, mesh)
+            if plans is not None:
+                plans = build_shard_plans(sg)
+            rebuild_s += _time.perf_counter() - tr
+            remats += 1
+            overflow_total += int(overflow)
+    wall = _time.perf_counter() - t0
+
+    extra = {
+        "devices": mesh.size, "remat_every": r, "remats": remats,
+        "remat_overflow_edges": overflow_total,
+        "epoch_rebuild_seconds_total": round(rebuild_s, 3),
+    }
+    if args.rounds > 0:
+        stats = type(stats_parts[0])(*(
+            np.concatenate([np.asarray(getattr(p, f)) for p in stats_parts])
+            for f in stats_parts[0]._fields
+        ))
+        if not args.quiet:
+            M.write_jsonl(stats, sys.stdout)
+        return _horizon_summary(args, stats, **extra), state
+    rounds = int(state.round)
+    sim_wall = wall - rebuild_s
+    summary = {
+        "summary": True, "mode": args.mode, "n_peers": args.peers,
+        "rounds": rounds, "target": args.target,
+        "wall_seconds": wall,
+        "peers_rounds_per_sec": args.peers * rounds / max(wall, 1e-9),
+        "coverage": float(state.coverage(0)),
+        "ms_per_round": sim_wall / max(rounds, 1) * 1000.0,
+        "ms_per_round_amortized": wall / max(rounds, 1) * 1000.0,
+        **extra,
+    }
+    return summary, state
+
+
 def _main_shard(args, graph, rng) -> int:
     """The --shard path: identical protocol, peers 1-D sharded over every
     available device with bucketed all_to_all fan-out (dist/mesh.py)."""
@@ -311,7 +446,11 @@ def _main_shard(args, graph, rng) -> int:
     state = shard_swarm(state, mesh)
 
     with trace(args.profile):
-        if args.rounds > 0:
+        if args.remat_every > 0:
+            summary, fin = _run_shard_with_remat(
+                args, cfg, state, sg, mesh, plans
+            )
+        elif args.rounds > 0:
             fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds, plans)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)

@@ -285,6 +285,25 @@ class PeerNode:
             await self._broadcast_gossip(line)
             await asyncio.sleep(self.timing.gossip_period)
 
+    def send_to_seeds(self, text: str) -> int:
+        """Forward a raw operator line to every connected seed — the
+        reference's stdin passthrough (Peer.py:441-442), which the seed
+        consumes as an "Unrecognized" line (Seed.py:440-441). Returns the
+        number of seeds written to."""
+        sent = 0
+        # frame with a newline: our seed parses its streams line-wise
+        # (readline), unlike the reference's raw recv() chunks — an
+        # unframed write would sit in the buffer and merge with the next
+        # protocol line into one garbage message
+        data = text.encode() if text.endswith("\n") else text.encode() + b"\n"
+        for seed_addr, writer in list(self.seed_writers.items()):
+            try:
+                writer.write(data)
+                sent += 1
+            except (ConnectionError, OSError):
+                self.log(f"Seed {seed_addr} unreachable for passthrough")
+        return sent
+
     def gossip(self, text: str) -> None:
         """Inject an application message into the swarm."""
         if self.transport == "tpu-sim":

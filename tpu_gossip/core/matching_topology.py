@@ -276,22 +276,9 @@ def _build_plan(
     dup_both = dup | (plan0.partner(dup.astype(jnp.int32), interpret=interpret) > 0)
     valid = alive & ~dup_both
 
-    # --- realized degrees + thresholds ----------------------------------
+    # --- realized degrees (thresholds are bound by with_fanout below, the
+    # ONE place the firing law lives) -------------------------------------
     deg_real = plan0.reduce(valid.astype(jnp.int32), op="sum")
-    push_thresh = pull_thresh = None
-    if fanout is not None:
-        deg_self = plan0.expand(deg_real)
-        deg_other = plan0.partner(deg_self, interpret=interpret)
-        push_thresh = jnp.where(
-            valid & (deg_other > 0),
-            bernoulli_threshold_device(fanout / jnp.maximum(deg_other, 1).astype(jnp.float32)),
-            jnp.uint32(0),
-        )
-        pull_thresh = jnp.where(
-            valid & (deg_self > 0),
-            bernoulli_threshold_device(1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)),
-            jnp.uint32(0),
-        )
 
     # --- CSR export (sentinel-row form, device_topology.py:152-161) ------
     src = jnp.where(valid, owner, n).reshape(-1)
@@ -304,8 +291,7 @@ def _build_plan(
     exists = jnp.arange(n + 1, dtype=jnp.int32) < n
 
     return (
-        l1, l2, m3, l2i, l1i, valid, push_thresh, pull_thresh, deg_real,
-        row_ptr, col_idx, exists,
+        l1, l2, m3, l2i, l1i, valid, deg_real, row_ptr, col_idx, exists,
     )
 
 
@@ -339,16 +325,17 @@ def matching_powerlaw_graph(
     rows = math.ceil(n_slots / (128 * 8)) * 8
     deg = jnp.asarray(deg_host)
     (
-        l1, l2, m3, l2i, l1i, valid, pth, qth, deg_real, row_ptr, col_idx,
-        exists,
+        l1, l2, m3, l2i, l1i, valid, deg_real, row_ptr, col_idx, exists,
     ) = _build_plan(
         key, deg, n=n, rows=rows, classes=classes, fanout=fanout,
         interpret=interpret,
     )
     plan = MatchingPlan(
         l1=l1, l2=l2, m3=m3, l2i=l2i, l1i=l1i, valid=valid,
-        push_thresh=pth, pull_thresh=qth, deg_real=deg_real,
-        n=n, rows=rows, classes=classes, fanout=fanout,
+        push_thresh=None, pull_thresh=None, deg_real=deg_real,
+        n=n, rows=rows, classes=classes, fanout=None,
     )
+    if fanout is not None:
+        plan = plan.with_fanout(fanout, interpret=interpret)
     graph = DeviceGraph(row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n)
     return graph, plan

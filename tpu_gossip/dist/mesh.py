@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -96,12 +97,18 @@ class ShardedGraph:
     recv_dst: jax.Array  # int32 (S, S, B)
     send_valid: jax.Array  # bool (S, S, B)
     send_dst_deg: jax.Array  # int32 (S, S, B)
+    send_src_deg: jax.Array  # int32 (S, S, B) — sender degree per bucket entry
     deg: jax.Array  # int32 (n_pad,) — slot degree (0 for pads)
     n: int = dataclasses.field(metadata=dict(static=True))
     n_pad: int = dataclasses.field(metadata=dict(static=True))
     n_shards: int = dataclasses.field(metadata=dict(static=True))
     per_shard: int = dataclasses.field(metadata=dict(static=True))
     bucket: int = dataclasses.field(metadata=dict(static=True))
+    # content digest of (recv_dst, send_valid), computed host-side at
+    # partition time: two partitions of the same graph can share
+    # (per, shards, bucket) yet route entries differently, and a plan built
+    # for the other one would gather received words silently out of order
+    fingerprint: int = dataclasses.field(default=0, metadata=dict(static=True))
 
 
 def partition_graph(
@@ -145,10 +152,15 @@ def partition_graph(
     recv_dst = np.zeros((s * s, b), dtype=np.int32)
     send_valid = np.zeros((s * s, b), dtype=bool)
     send_dst_deg = np.ones((s * s, b), dtype=np.int32)
+    send_src_deg = np.ones((s * s, b), dtype=np.int32)
     send_src[gs, k] = (ss - (gs // s) * per).astype(np.int32)
     recv_dst[gs, k] = (ds - (gs % s) * per).astype(np.int32)
     send_valid[gs, k] = True
     send_dst_deg[gs, k] = deg[ds]
+    # sender degree as a static bucket table: the push activation law
+    # (fanout/deg(src)) then streams instead of gathering deg[send_src]
+    # per edge per round
+    send_src_deg[gs, k] = deg[ss]
 
     sg = ShardedGraph(
         send_src=jnp.asarray(send_src.reshape(s, s, b)),
@@ -157,14 +169,27 @@ def partition_graph(
         recv_dst=jnp.asarray(recv_dst.reshape(s, s, b).transpose(1, 0, 2)),
         send_valid=jnp.asarray(send_valid.reshape(s, s, b)),
         send_dst_deg=jnp.asarray(send_dst_deg.reshape(s, s, b)),
+        send_src_deg=jnp.asarray(send_src_deg.reshape(s, s, b)),
         deg=jnp.asarray(deg),
         n=n,
         n_pad=n_pad,
         n_shards=s,
         per_shard=per,
         bucket=b,
+        fingerprint=_routing_fingerprint(
+            recv_dst.reshape(s, s, b).transpose(1, 0, 2),
+            send_valid.reshape(s, s, b),
+        ),
     )
     return sg, relabeled, position
+
+
+def _routing_fingerprint(recv_dst: np.ndarray, send_valid: np.ndarray) -> int:
+    """crc32 over the receive routing tables (host arrays, partition time)."""
+    crc = zlib.crc32(np.ascontiguousarray(recv_dst, dtype=np.int32).tobytes())
+    return zlib.crc32(
+        np.ascontiguousarray(send_valid, dtype=np.uint8).tobytes(), crc
+    )
 
 
 @jax.tree_util.register_dataclass
@@ -196,14 +221,17 @@ class ShardPlans:
     # received words and XLA's clamping gather would make it silently wrong)
     n_shards: int = dataclasses.field(default=0, metadata=dict(static=True))
     bucket: int = dataclasses.field(default=0, metadata=dict(static=True))
+    fingerprint: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     def check_matches(self, sg: "ShardedGraph") -> None:
-        got = (self.per, self.n_shards, self.bucket)
-        want = (sg.per_shard, sg.n_shards, sg.bucket)
+        got = (self.per, self.n_shards, self.bucket, self.fingerprint)
+        want = (sg.per_shard, sg.n_shards, sg.bucket, sg.fingerprint)
         if got != want:
             raise ValueError(
-                f"shard_plan built for (per, shards, bucket)={got} but the "
-                f"graph has {want} — rebuild with build_shard_plans(sg)"
+                f"shard_plan built for (per, shards, bucket, fingerprint)="
+                f"{got} but the graph has {want} — two partitions can share "
+                f"sizes yet route differently; rebuild with "
+                f"build_shard_plans(sg)"
             )
 
 
@@ -262,6 +290,7 @@ def build_shard_plans(sg: ShardedGraph, *, rows: int = 1024) -> ShardPlans:
         rows=rows,
         n_shards=s,
         bucket=b,
+        fingerprint=sg.fingerprint,
     )
 
 
@@ -379,7 +408,7 @@ def _exchange(
     sg: ShardedGraph,
     keys: jax.Array,
     mesh: Mesh,
-    activation: str,  # "push" | "pull" | "flood"
+    activation: str,  # "push" | "pull" | "flood" | "push_pull" (merged)
     fanout: int,
     blocked_rows: jax.Array | None = None,
     shard_plan: ShardPlans | None = None,
@@ -400,10 +429,17 @@ def _exchange(
     filter, msgs accounting) is unchanged, so the two receive paths are
     bit-identical in output and billing.
     """
+    from tpu_gossip.kernels.pallas_segment import (
+        StaircasePlan, _launch, _slot_groups, pack_words, unpack_words,
+    )
+
     s, b = sg.n_shards, sg.bucket
     per = sg.per_shard
     m = transmit.shape[1]
-    if blocked_rows is None:
+    groups = _slot_groups(m)
+    g_count = len(groups)
+    has_blocked = blocked_rows is not None
+    if not has_blocked:
         blocked_rows = jnp.zeros(transmit.shape[0], dtype=bool)
     if shard_plan is not None:
         shard_plan.check_matches(sg)
@@ -411,6 +447,7 @@ def _exchange(
         shard_plan.tile_block, shard_plan.first_visit,
         shard_plan.offs, shard_plan.entry_gather,
     )
+    merged = activation == "push_pull"
 
     @functools.partial(
         jax.shard_map,
@@ -421,41 +458,83 @@ def _exchange(
         # tables, which the varying-axes checker cannot type (see _launch)
         check_vma=shard_plan is None,
     )
-    def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, deg_blk, key_blk,
+    def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, src_deg, key_blk,
            blocked_blk, *plan_blks):
         send_src, recv_dst = send_src[0], recv_dst[0]  # (S, B)
-        valid, dst_deg = valid[0], dst_deg[0]
-        vals = transmit_blk[send_src]  # (S, B, M)
+        valid, dst_deg, src_deg = valid[0], dst_deg[0], src_deg[0]
+        # pack ONCE at node granularity, then ONE per-edge gather of G int32
+        # words (the old path gathered M bools per edge per direction and
+        # deg[send_src] besides — 3x the random access, 4x the ICI bytes at
+        # m=16)
+        words = jnp.stack(
+            [pack_words(transmit_blk[:, lo : lo + w]) for lo, w in groups],
+            axis=-1,
+        )  # (per, G)
+        vals = words[send_src]  # (S, B, G) — THE send-side gather
         if activation == "flood":
-            active = valid
+            payload = jnp.where(valid[:, :, None], vals, 0)
         elif activation == "push":
             # Bernoulli k/deg(src) per out-edge ≡ fanout-k sampling with
-            # static shapes (expected k pushes per transmitting peer)
-            p = fanout / jnp.maximum(deg_blk[send_src], 1)
+            # static shapes (expected k pushes per transmitting peer);
+            # src_deg is a static bucket table, no gather
+            p = fanout / jnp.maximum(src_deg, 1)
             active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
-        else:  # pull: destination draws ~1 incoming edge
+            payload = jnp.where(active[:, :, None], vals, 0)
+        elif activation == "pull":
             p = 1.0 / jnp.maximum(dst_deg, 1)
             active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
-        payload = vals & active[:, :, None]  # (S, B, M)
+            payload = jnp.where(active[:, :, None], vals, 0)
+        else:  # merged push_pull: ONE transport for both directions
+            kp, kq = jax.random.split(key_blk[0])
+            act_p = valid & (
+                jax.random.uniform(kp, (s, b))
+                < fanout / jnp.maximum(src_deg, 1)
+            )
+            act_q = valid & (
+                jax.random.uniform(kq, (s, b))
+                < 1.0 / jnp.maximum(dst_deg, 1)
+            )
+            payload = jnp.where((act_p | act_q)[:, :, None], vals, 0)
+            # per-direction billing rides two word bits alongside the words
+            acts = act_p.astype(jnp.int32) | (act_q.astype(jnp.int32) << 1)
+            payload = jnp.concatenate([payload, acts[:, :, None]], axis=-1)
         received = jax.lax.all_to_all(
             payload, AXIS, split_axis=0, concat_axis=0, tiled=True
         )  # received[s'] = bucket shard s' packed for me
+        if merged:
+            acts_r = received[:, :, g_count]
+            received = received[:, :, :g_count]
         # receiver-side stale filter BEFORE counting (stale deliveries are
-        # neither delivered nor billed, like the local engine's edge masks)
-        received = received & ~blocked_blk[recv_dst][:, :, None]
-        msgs = jnp.sum(received, dtype=jnp.int32)
-        flat = received.reshape(s * b, m)
+        # neither delivered nor billed, like the local engine's edge masks);
+        # the per-edge blocked gather only exists under churn re-wiring
+        if has_blocked:
+            keep = ~blocked_blk[recv_dst]
+            received = jnp.where(keep[:, :, None], received, 0)
+            if merged:
+                acts_r = jnp.where(keep, acts_r, 0)
+        pc = jax.lax.population_count
+        if merged:
+            mask_p = -(acts_r & 1)  # 0 or all-ones
+            mask_q = -((acts_r >> 1) & 1)
+            msgs = jnp.sum(
+                pc(received & mask_p[:, :, None])
+                + pc(received & mask_q[:, :, None]),
+                dtype=jnp.int32,
+            )
+        else:
+            msgs = jnp.sum(pc(received), dtype=jnp.int32)
+        flat = received.reshape(s * b, g_count)
         if shard_plan is None:
+            bits = jnp.concatenate(
+                [unpack_words(flat[:, gi], w) for gi, (_, w) in enumerate(groups)],
+                axis=1,
+            )
             incoming = (
                 jnp.zeros((per, m), dtype=bool)
                 .at[recv_dst.reshape(-1)]
-                .max(flat, mode="drop")
+                .max(bits, mode="drop")
             )
         else:
-            from tpu_gossip.kernels.pallas_segment import (
-                StaircasePlan, _launch, _slot_groups, pack_words,
-            )
-
             local_plan = StaircasePlan(
                 tile_block=plan_blks[0][0],
                 first_visit=plan_blks[1][0],
@@ -467,20 +546,15 @@ def _exchange(
                 rows=shard_plan.rows,
             )
             outs = [
-                _launch(
-                    local_plan,
-                    pack_words(flat[:, lo : lo + w])[local_plan.col_gather],
-                    w,
-                    None,
-                )
-                for lo, w in _slot_groups(m)
+                _launch(local_plan, flat[:, gi][local_plan.col_gather], w, None)
+                for gi, (_, w) in enumerate(groups)
             ]
             incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
         return incoming, msgs[None]
 
     return ex(
         transmit, sg.send_src, sg.recv_dst, sg.send_valid, sg.send_dst_deg,
-        sg.deg, keys, blocked_rows, *plan_args,
+        sg.send_src_deg, keys, blocked_rows, *plan_args,
     )
 
 
@@ -525,23 +599,39 @@ def gossip_round_dist(
 
     incoming = jnp.zeros_like(state.seen)
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
-    if cfg.mode in ("push", "push_pull"):
+    merged_pp = cfg.mode == "push_pull" and not cfg.forward_once
+    if merged_pp:
+        # without forward_once the pull answer IS the push transmit bitmap,
+        # so both directions ride ONE bucket transport (one send gather, one
+        # all_to_all, one receive) with per-direction billing bits — half
+        # the exchanges of the split path
+        inc, msgs = _exchange(
+            static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
+            "push_pull", cfg.fanout, blocked_rows=blocked,
+            shard_plan=shard_plan,
+        )
+        incoming = incoming | inc
+        # delivered bits + one request per pulling peer, mirroring the local
+        # engine's accounting (sim/engine.py _disseminate_local); rewired
+        # pullers are billed in fresh_rewire_traffic instead, not twice
+        pulls = (sg.deg > 0) & receptive.any(-1)
+        if rewiring:
+            pulls = pulls & ~state.rewired
+        msgs_sent = msgs_sent + jnp.sum(msgs) + jnp.sum(pulls, dtype=jnp.int32)
+    if cfg.mode in ("push", "push_pull") and not merged_pp:
         inc, msgs = _exchange(
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "push", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
-    if cfg.mode == "push_pull":
+    if cfg.mode == "push_pull" and not merged_pp:
         static_answer = answer & ~state.rewired[:, None] if rewiring else answer
         inc, msgs = _exchange(
             static_answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
             "pull", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
         )
         incoming = incoming | inc
-        # delivered bits + one request per pulling peer, mirroring the local
-        # engine's accounting (sim/engine.py _disseminate_local); rewired
-        # pullers are billed in fresh_rewire_traffic instead, not twice
         pulls = (sg.deg > 0) & receptive.any(-1)
         if rewiring:
             pulls = pulls & ~state.rewired

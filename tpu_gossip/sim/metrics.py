@@ -85,6 +85,12 @@ def bench_swarm(
     reported swarm size (e.g. the real peer count when ``cfg.n_peers`` is
     a padded slot count).
     """
+    if run is not None and plan is not None:
+        raise ValueError(
+            "bench_swarm: pass plan= only with the default runner — a "
+            "custom run= callable closes over its own delivery plan and "
+            "the plan argument would be silently ignored"
+        )
     if run is None:
         run = lambda: run_until_coverage(  # noqa: E731
             state, cfg, target, max_rounds, plan=plan)
