@@ -117,6 +117,7 @@ def partition_graph(
     *,
     seed: int = 0,
     permute: bool = True,
+    window: int = 1024,
 ) -> tuple[ShardedGraph, Graph, np.ndarray]:
     """Partition a host graph for ``n_shards`` devices.
 
@@ -124,6 +125,9 @@ def partition_graph(
     ``relabeled_graph`` is the padded, permuted CSR (so the single-device
     engine can run the *identical* topology for parity tests) and
     ``position[old_id] = slot`` maps original peer ids to state rows.
+    ``window`` aligns bucket capacity for the streaming kernel receive
+    (build_shard_plans requires the default 1024; window=1 disables the
+    alignment for scatter-only use).
     """
     n, s = graph.n, n_shards
     per = math.ceil(n / s)
@@ -141,8 +145,19 @@ def partition_graph(
 
     gid = (src // per) * s + (dst // per)  # (S*S,) bucket id per directed edge
     counts = np.bincount(gid, minlength=s * s)
-    b = max(int(counts.max()), 1)
-    order = np.argsort(gid, kind="stable")
+    # bucket capacity: max count rounded up to a whole number of
+    # ``window``-entry kernel windows, so each source shard's received run
+    # is window-aligned for the zero-gather streaming receive
+    # (build_shard_plans). The padding is bounded by window-1 entries per
+    # (src, dst) pair — sub-0.1% at headline scales, and a few KB of table
+    # absolutely at toy scales; pass window=1 to opt out when the kernel
+    # receive will never run
+    b = max(-(-max(int(counts.max()), 1) // window) * window, window)
+    # entries within each bucket sorted by DESTINATION row: the receiving
+    # shard's all_to_all result is then S dest-sorted runs, which the
+    # windowed staircase kernel consumes by direct block streaming — no
+    # entry_gather, no per-edge random access on the receive side
+    order = np.lexsort((dst, gid))
     gs, ss, ds = gid[order], src[order], dst[order]
     starts = np.zeros(s * s + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
@@ -199,26 +214,31 @@ class ShardPlans:
     (the north star's fusion: "a single Pallas segment-scatter kernel …
     peers 1-D sharded across the TPU mesh").
 
-    One :class:`~tpu_gossip.kernels.pallas_segment.StaircasePlan` per
-    destination shard, stacked on a leading shard axis so ``shard_map`` can
-    hand each device its own routing tables. All shards share one static
-    tile count (``n_tiles``) — SPMD programs need identical shapes — with
-    inert padding tiles absorbing the imbalance. ``entry_gather`` indexes
-    the shard's flattened ``all_to_all`` result (the (S*B,) received-word
-    vector), playing the role ``col_gather`` plays against a CSR.
+    WINDOWED (zero-gather) layout: because partition_graph dest-sorts each
+    bucket and pads buckets to whole 1024-entry windows, every destination
+    shard's ``all_to_all`` result is S dest-sorted runs, and each tile of
+    the staircase kernel can STREAM its words from one aligned window of
+    that flat result (``window_idx``), with ``offs`` masking positions
+    outside the tile's (block, run) segment. No per-entry gather exists on
+    the receive side at all — the r4 receive path gathered every received
+    word once per round (``entry_gather``), which at 1M was ~44 ms of the
+    round. All shards share one static tile count (``n_tiles``) — SPMD
+    programs need identical shapes — with inert padding tiles absorbing the
+    imbalance.
     """
 
     tile_block: jax.Array  # int32 (S, T)
     first_visit: jax.Array  # int32 (S, T)
     offs: jax.Array  # int32 (S, T*8, 128)
-    entry_gather: jax.Array  # int32 (S, T*8, 128)
+    window_idx: jax.Array  # int32 (S, T) — aligned 1024-word window per tile
     per: int = dataclasses.field(metadata=dict(static=True))
     n_tiles: int = dataclasses.field(metadata=dict(static=True))
     n_blocks: int = dataclasses.field(metadata=dict(static=True))
     rows: int = dataclasses.field(default=1024, metadata=dict(static=True))
-    # provenance of the bucket layout the tables index — checked against the
-    # ShardedGraph at exchange time (a mismatched plan gathers out-of-order
-    # received words and XLA's clamping gather would make it silently wrong)
+    # provenance of the bucket layout the tables index — checked against
+    # the ShardedGraph at exchange time (a plan from a different partition
+    # would stream windows whose offs tables describe other entries,
+    # silently delivering to wrong rows)
     n_shards: int = dataclasses.field(default=0, metadata=dict(static=True))
     bucket: int = dataclasses.field(default=0, metadata=dict(static=True))
     fingerprint: int = dataclasses.field(default=0, metadata=dict(static=True))
@@ -236,57 +256,112 @@ class ShardPlans:
 
 
 def build_shard_plans(sg: ShardedGraph, *, rows: int = 1024) -> ShardPlans:
-    """Staircase plans over each shard's RECEIVE side of the bucket tables.
+    """Windowed staircase plans over each shard's RECEIVE side.
 
     The dist engine's receive-side scatter (``.at[recv_dst].max`` over the
     all_to_all result) is the same serialized segment reduction the local
-    staircase kernel replaces (reference Peer.py:395-408) — so build, per
-    destination shard, a staircase plan whose "edges" are the shard's valid
-    bucket entries sorted by receiver-local row. Sorting is what the CSR
-    gave the local plan for free; ``entry_gather`` carries the sort so the
-    kernel gathers packed received words in destination order. Host-side,
-    once per partitioned graph, like ``partition_graph`` itself.
+    staircase kernel replaces (reference Peer.py:395-408). Because
+    partition_graph dest-sorts every bucket and pads capacity to whole
+    1024-entry windows, each received run is already destination-sorted and
+    window-aligned — so the plan is pure bookkeeping: one tile per
+    (window, block) incidence, with ``window_idx`` steering the kernel's
+    input BlockSpec and ``offs`` masking window positions outside the
+    tile's segment. The kernel then STREAMS the all_to_all result
+    (pallas_segment.stream_segment_or) — no per-entry gather exists on the
+    receive side. Host-side, once per partitioned graph, like
+    ``partition_graph`` itself.
     """
-    from tpu_gossip.kernels.pallas_segment import (
-        TILE, _pad_tiles, build_staircase_plan,
-    )
+    from tpu_gossip.kernels.pallas_segment import TILE, _pad_tiles
 
     s, b, per = sg.n_shards, sg.bucket, sg.per_shard
+    if b % TILE != 0:
+        raise ValueError(
+            f"bucket capacity {b} is not window-aligned — partition the "
+            f"graph with partition_graph(..., window={TILE}) (the default)"
+        )
+    n_blocks = max(1, -(-per // rows))
     recv_dst = np.asarray(sg.recv_dst)  # (S_dst, S_src, B)
     # valid viewed from the receiver: send_valid is (src, dst, b)
     recv_valid = np.asarray(sg.send_valid).transpose(1, 0, 2)
+    w_per_run = b // TILE
 
-    per_shard_csr = []
-    t_min = 0
-    for d in range(s):
-        flat_dst = recv_dst[d].reshape(-1)
-        flat_ok = recv_valid[d].reshape(-1)
-        entries = np.nonzero(flat_ok)[0]
-        order = entries[np.argsort(flat_dst[entries], kind="stable")]
-        counts = np.bincount(flat_dst[order], minlength=per)
-        row_ptr = np.zeros(per + 1, dtype=np.int64)
-        np.cumsum(counts, out=row_ptr[1:])
-        per_shard_csr.append((row_ptr, order))
-        # this shard's minimum grid: >=1 tile per rows-row block, no tile
-        # spanning blocks (mirrors build_staircase_plan's accounting)
-        blocks = np.arange(max(1, -(-per // rows)))
-        starts = row_ptr[np.minimum(blocks * rows, per)]
-        ends = row_ptr[np.minimum((blocks + 1) * rows, per)]
-        t_min = max(t_min, int(np.maximum(1, -(-(ends - starts) // TILE)).sum()))
+    def shard_tiles(d):
+        """(tb, wi, offs) for dest shard d, tiles block-major so
+        output-block revisits stay consecutive. Vectorized per source run:
+        a tile is one (window, block) incidence — a window shared by two
+        blocks yields two tiles with complementary ``offs`` masks."""
+        tb_parts, wi_parts, run_parts = [], [], []
+        for r in range(s):
+            dstr = recv_dst[d, r]
+            cnt = int(recv_valid[d, r].sum())  # valid entries lead
+            if cnt == 0:
+                continue
+            dwin = dstr.reshape(w_per_run, TILE)
+            nw = -(-cnt // TILE)  # windows with any valid entry
+            w_ids = np.arange(nw)
+            last = np.minimum((w_ids + 1) * TILE, cnt) - 1
+            blk_lo = dwin[w_ids, 0] // rows  # dest-sorted: window endpoints
+            blk_hi = dstr[last] // rows  # bound its block span
+            counts = blk_hi - blk_lo + 1
+            wrep = np.repeat(w_ids, counts)
+            koff = np.arange(len(wrep)) - np.repeat(
+                np.cumsum(counts) - counts, counts
+            )
+            tb_parts.append((np.repeat(blk_lo, counts) + koff).astype(np.int32))
+            wi_parts.append((r * w_per_run + wrep).astype(np.int32))
+            run_parts.append(np.full(len(wrep), r, dtype=np.int32))
+        if tb_parts:
+            tb_r = np.concatenate(tb_parts)
+            wi_r = np.concatenate(wi_parts)
+            run_r = np.concatenate(run_parts)
+        else:
+            tb_r = wi_r = run_r = np.zeros(0, dtype=np.int32)
+        # inert zero-init tiles for blocks with no entries in any run
+        missing = np.setdiff1d(np.arange(n_blocks, dtype=np.int32), tb_r)
+        tb_all = np.concatenate([tb_r, missing])
+        wi_all = np.concatenate([wi_r, np.zeros(len(missing), np.int32)])
+        run_all = np.concatenate([run_r, np.full(len(missing), -1, np.int32)])
+        order = np.lexsort((run_all, wi_all, tb_all))  # block-major
+        tb_all, wi_all, run_all = tb_all[order], wi_all[order], run_all[order]
+        # offs: per tile, each window position's block-local dest row or -1
+        dvals = recv_dst[d].reshape(s * w_per_run, TILE)[wi_all]  # (T_d, TILE)
+        cnts = np.array(
+            [int(recv_valid[d, r].sum()) for r in range(s)] or [0], np.int32
+        )
+        pos_in_run = (wi_all % w_per_run)[:, None] * TILE + np.arange(TILE)
+        valid_pos = (run_all[:, None] >= 0) & (
+            pos_in_run < cnts[np.maximum(run_all, 0)][:, None]
+        )
+        offs_all = np.where(
+            valid_pos & (dvals // rows == tb_all[:, None]),
+            dvals - tb_all[:, None] * rows,
+            -1,
+        ).astype(np.int32)
+        return tb_all, wi_all, offs_all
 
-    T = _pad_tiles(t_min)
-    plans = [
-        build_staircase_plan(row_ptr, order, rows=rows, n_tiles=T)
-        for row_ptr, order in per_shard_csr
-    ]
+    per_shard = [shard_tiles(d) for d in range(s)]
+    T = _pad_tiles(max(len(t[0]) for t in per_shard))
+
+    tb = np.full((s, T), n_blocks - 1, dtype=np.int32)
+    fv = np.zeros((s, T), dtype=np.int32)
+    wi = np.zeros((s, T), dtype=np.int32)
+    offs = np.full((s, T, TILE), -1, dtype=np.int32)
+    for d, (tb_d, wi_d, offs_d) in enumerate(per_shard):
+        k = len(tb_d)
+        tb[d, :k] = tb_d
+        wi[d, :k] = wi_d
+        offs[d, :k] = offs_d
+        fv[d, 0] = 1
+        fv[d, 1:k] = tb_d[1:] != tb_d[:-1]
+
     return ShardPlans(
-        tile_block=jnp.stack([p.tile_block for p in plans]),
-        first_visit=jnp.stack([p.first_visit for p in plans]),
-        offs=jnp.stack([p.offs for p in plans]),
-        entry_gather=jnp.stack([p.col_gather for p in plans]),
+        tile_block=jnp.asarray(tb),
+        first_visit=jnp.asarray(fv),
+        offs=jnp.asarray(offs.reshape(s, T * 8, 128)),
+        window_idx=jnp.asarray(wi),
         per=per,
         n_tiles=T,
-        n_blocks=plans[0].n_blocks,
+        n_blocks=n_blocks,
         rows=rows,
         n_shards=s,
         bucket=b,
@@ -430,7 +505,7 @@ def _exchange(
     bit-identical in output and billing.
     """
     from tpu_gossip.kernels.pallas_segment import (
-        StaircasePlan, _launch, _slot_groups, pack_words, unpack_words,
+        _slot_groups, pack_words, stream_segment_or, unpack_words,
     )
 
     s, b = sg.n_shards, sg.bucket
@@ -445,7 +520,7 @@ def _exchange(
         shard_plan.check_matches(sg)
     plan_args = () if shard_plan is None else (
         shard_plan.tile_block, shard_plan.first_visit,
-        shard_plan.offs, shard_plan.entry_gather,
+        shard_plan.offs, shard_plan.window_idx,
     )
     merged = activation == "push_pull"
 
@@ -535,18 +610,16 @@ def _exchange(
                 .max(bits, mode="drop")
             )
         else:
-            local_plan = StaircasePlan(
-                tile_block=plan_blks[0][0],
-                first_visit=plan_blks[1][0],
-                offs=plan_blks[2][0],
-                col_gather=plan_blks[3][0],
-                n=per,
-                n_tiles=shard_plan.n_tiles,
-                n_blocks=shard_plan.n_blocks,
-                rows=shard_plan.rows,
-            )
+            # zero-gather receive: dest-sorted runs stream straight into the
+            # windowed staircase kernel (pallas_segment.stream_segment_or)
             outs = [
-                _launch(local_plan, flat[:, gi][local_plan.col_gather], w, None)
+                stream_segment_or(
+                    plan_blks[0][0], plan_blks[1][0], plan_blks[3][0],
+                    plan_blks[2][0], flat[:, gi], w,
+                    n=per, n_tiles=shard_plan.n_tiles,
+                    n_blocks=shard_plan.n_blocks, rows=shard_plan.rows,
+                    interpret=None,
+                )
                 for gi, (_, w) in enumerate(groups)
             ]
             incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)

@@ -56,6 +56,7 @@ __all__ = [
     "unpack_words",
     "segment_or",
     "segment_sampled",
+    "stream_segment_or",
 ]
 
 # Default output rows per block (out block last dim). Re-tuned 2026-07-30
@@ -397,41 +398,48 @@ def unpack_words(words: jax.Array, m: int) -> jax.Array:
     return ((words[:, None] >> jnp.arange(m, dtype=jnp.int32)[None, :]) & 1).astype(bool)
 
 
+def _tile_contract_accumulate(
+    m: int, rows: int, fv_ref, offs_ref, vals_ref, bill_ref, out_ref
+):
+    """The ONE staircase tile computation (shared by every kernel variant):
+    unpack bit planes, build the iota one-hot, contract on the MXU, and
+    zero-init / accumulate the output block by first-visit. With
+    ``bill_ref``, one extra contraction plane segment-sums per-edge counts
+    on the same matmul (see the bill-exactness note on
+    :func:`segment_sampled`)."""
+    t = pl.program_id(0)
+    offs = offs_ref[:].reshape(1, TILE)  # (1, 1024)
+    words = vals_ref[:].reshape(1, TILE)
+    planes = [((words >> s) & 1).astype(jnp.float32) for s in range(m)]
+    if bill_ref is not None:
+        planes.append(bill_ref[:].reshape(1, TILE).astype(jnp.float32))
+    bits = jnp.concatenate(planes, axis=0)  # (m [+1], 1024)
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, TILE), 0) == offs
+    ).astype(jnp.float32)  # (rows, 1024); offs=-1 matches nothing
+    acc = jax.lax.dot_general(
+        bits, onehot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (m [+1], rows)
+
+    @pl.when(fv_ref[t] == 1)
+    def _():
+        out_ref[0] = acc
+
+    @pl.when(fv_ref[t] == 0)
+    def _():
+        out_ref[0] = out_ref[0] + acc
+
+
 def _kernel(m: int, rows: int, billed: bool):
-    """Staircase tile kernel. With ``billed``, a second per-edge int32 input
-    is appended to the bit planes as one extra contraction plane, so its
-    per-destination-row SUM rides the same MXU matmul — this is how pull
-    billing is segment-reduced without any random gather. The f32 sums are
-    exact while every row's bill stays < 2^24; see the bill-exactness note
-    on :func:`segment_sampled` for why the pull thresholds guarantee that
-    with probability 1 minus something astronomically small."""
+    """Staircase tile kernel over gathered edge arrays (col_gather feed)."""
 
     def kernel(tb_ref, fv_ref, offs_ref, vals_ref, *rest):
         bill_ref, out_ref = rest if billed else (None, rest[0])
-        t = pl.program_id(0)
-        offs = offs_ref[:].reshape(1, TILE)  # (1, 1024)
-        words = vals_ref[:].reshape(1, TILE)
-        planes = [
-            ((words >> s) & 1).astype(jnp.float32) for s in range(m)
-        ]
-        if billed:
-            planes.append(bill_ref[:].reshape(1, TILE).astype(jnp.float32))
-        bits = jnp.concatenate(planes, axis=0)  # (m [+1], 1024)
-        onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, (rows, TILE), 0) == offs
-        ).astype(jnp.float32)  # (rows, 1024); offs=-1 matches nothing
-        acc = jax.lax.dot_general(
-            bits, onehot, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (m [+1], rows)
-
-        @pl.when(fv_ref[t] == 1)
-        def _():
-            out_ref[0] = acc
-
-        @pl.when(fv_ref[t] == 0)
-        def _():
-            out_ref[0] = out_ref[0] + acc
+        del tb_ref  # consumed by the output index map only
+        _tile_contract_accumulate(
+            m, rows, fv_ref, offs_ref, vals_ref, bill_ref, out_ref
+        )
 
     return kernel
 
@@ -479,6 +487,67 @@ def _launch(
     if billed:
         return inc, flat[: plan.n, m]
     return inc
+
+
+def _stream_kernel(m: int, rows: int):
+    """Staircase tile kernel with a prefetched WINDOW table: tile t reads
+    its 1024 words from aligned window ``wi[t]`` of a flat value stream
+    instead of from a gathered edge array — the zero-gather receive path
+    (dist/mesh.py): dest-sorted bucket runs are streamed straight out of the
+    ``all_to_all`` result, and ``offs`` masks the window positions outside
+    the tile's (block, run) segment with -1."""
+
+    def kernel(tb_ref, fv_ref, wi_ref, offs_ref, vals_ref, out_ref):
+        del tb_ref, wi_ref  # consumed by the index maps only
+        _tile_contract_accumulate(
+            m, rows, fv_ref, offs_ref, vals_ref, None, out_ref
+        )
+
+    return kernel
+
+
+def stream_segment_or(
+    tile_block: jax.Array,
+    first_visit: jax.Array,
+    window_idx: jax.Array,
+    offs: jax.Array,
+    vals_flat: jax.Array,
+    m: int,
+    *,
+    n: int,
+    n_tiles: int,
+    n_blocks: int,
+    rows: int = ROWS,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Segment-OR over a FLAT packed-word stream with per-tile windows.
+
+    ``vals_flat`` (L,) int32 with L a multiple of 1024; tile t consumes
+    words [1024*window_idx[t], 1024*(window_idx[t]+1)) — no gather anywhere.
+    ``offs`` (T*8, 128) holds each window position's destination row offset
+    within the tile's output block, or -1 for positions outside the tile's
+    segment. Returns (n, m) bool."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    vals2d = vals_flat.reshape(-1, 128)
+    edge_spec = pl.BlockSpec((8, 128), lambda t, tb, fv, wi: (t, 0))
+    vals_spec = pl.BlockSpec((8, 128), lambda t, tb, fv, wi: (wi[t], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n_tiles,),
+        in_specs=[edge_spec, vals_spec],
+        out_specs=pl.BlockSpec(
+            (1, m, rows), lambda t, tb, fv, wi: (tb[t], 0, 0)
+        ),
+    )
+    out = pl.pallas_call(
+        _stream_kernel(m, rows),
+        out_shape=jax.ShapeDtypeStruct((n_blocks, m, rows), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tile_block, first_visit, window_idx, offs, vals2d)
+    flat = out.transpose(0, 2, 1).reshape(n_blocks * rows, m)
+    return flat[:n, :m] > 0.5
 
 
 @functools.partial(jax.jit, static_argnames=("m", "interpret"))
