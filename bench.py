@@ -301,19 +301,22 @@ def bench_liveness(n: int = 1000, silent_frac: float = 0.1, rounds: int = 20,
 
 
 def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
-                      remat_every: int = 16):
+                      remat_every: int = 16, plan=None,
+                      rewire_compact_cap: int = 0):
     """BASELINE config 5 with periodic re-materialization, measured honestly.
 
     Churn runs ``remat_every`` rounds, the fresh edges are folded into the
     CSR (sim.engine.rematerialize_rewired), and the NEXT segment plus the
-    rebuild's warm cost are measured. Recorded result (2026-07-30, 1M):
-    the rebuild is ~0.8 s but the segment rate does NOT drop below the
-    plain churn config's — the rewire side paths are config-structural
-    (jit runs them regardless of how many peers are currently rewired), so
-    remat's value is bounding the rewired fraction over long horizons and
-    enabling dist epoch rebuilds (repartition_swarm), not round rate. The
-    entry stays in the matrix precisely so that claim is backed by a
-    number rather than an assumption.
+    rebuild's warm cost are measured. With ``rewire_compact_cap`` the
+    segment runs the bounded-table side paths — the remat-era operating
+    point: the cap only has to hold ``remat_every`` rounds of joiners
+    (the fold empties the rewired set), so it can be ~N·join_prob·R
+    instead of the whole-horizon accumulation the no-remat compact entry
+    needs. The amortized figure's floor decomposes as
+    base + O(cap) side paths + remat_seconds/remat_every — remat is a
+    LONG-HORIZON correctness mechanism (the rewired set cannot grow
+    without bound), not a short-run rate win; this entry prices that
+    trade instead of asserting it (docs/kernel_profile_1m.md addendum).
     """
     import jax
     import numpy as np
@@ -326,22 +329,42 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     cfg = SwarmConfig(
         n_peers=dg.n_pad, msg_slots=msg_slots, fanout=1, mode="push_pull",
         churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+        rewire_compact_cap=rewire_compact_cap,
     )
     state = init_swarm(
         dg.as_padded_graph(), cfg, origins=np.arange(msg_slots),
         origin_slots=np.arange(msg_slots), exists=dg.exists,
         key=jax.random.key(0),
     )
-    cap = remat_capacity(state, cfg)
-    state, _ = simulate(state, cfg, remat_every)  # accumulate real churn
-    state, _ = rematerialize_rewired(state, cfg, cap)
+    def rebuild_plan(st):
+        """Post-remat kernel plan: the fold changed the CSR, and
+        rematerialize_rewired's contract requires plan holders to rebuild
+        (stale plans would deliver the DROPPED edges and miss the folded
+        fresh ones). Device build; cost is part of the epoch charge."""
+        if plan is None:
+            return None, 0.0
+        from tpu_gossip.kernels.pallas_segment import (
+            build_staircase_plan_device,
+        )
 
-    fin, _ = simulate(state, cfg, remat_every)  # warm the capacity shape
+        t0 = time.perf_counter()
+        p = build_staircase_plan_device(
+            st.row_ptr, st.col_idx, fanout=cfg.fanout, rows=plan.rows
+        )
+        int(p.offs[-1, -1])  # fetch = completion barrier
+        return p, time.perf_counter() - t0
+
+    cap = remat_capacity(state, cfg)
+    state, _ = simulate(state, cfg, remat_every, plan)  # accumulate real churn
+    state, _ = rematerialize_rewired(state, cfg, cap)
+    seg_plan, _ = rebuild_plan(state)
+
+    fin, _ = simulate(state, cfg, remat_every, seg_plan)  # warm capacity shape
     float(fin.coverage(0))
     best = float("inf")
     for _ in range(max(reps, 1)):
         t0 = time.perf_counter()
-        fin, _ = simulate(state, cfg, remat_every)
+        fin, _ = simulate(state, cfg, remat_every, seg_plan)
         float(fin.coverage(0))  # completion barrier
         best = min(best, time.perf_counter() - t0)
     seg_ms = best / remat_every * 1000.0
@@ -352,14 +375,20 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     nxt, ov = rematerialize_rewired(fin, cfg, cap)
     overflow = int(ov)  # fetch = completion barrier
     remat_s = time.perf_counter() - t0
+    _, plan_rebuild_s = rebuild_plan(nxt)  # warmed (same shapes as above)
+    epoch_s = remat_s + plan_rebuild_s
     return {
         "n_peers": dg.n_pad, "msg_slots": msg_slots,
         "remat_every": remat_every,
         "ms_per_round": round(seg_ms, 4),
         "remat_seconds": round(remat_s, 3),
-        "ms_per_round_amortized": round(seg_ms + remat_s * 1000.0 / remat_every, 4),
+        "plan_rebuild_seconds": round(plan_rebuild_s, 3),
+        "ms_per_round_amortized": round(
+            seg_ms + epoch_s * 1000.0 / remat_every, 4
+        ),
         "overflow_edges": overflow,
-        "delivery": "xla",
+        "rewire_compact_cap": rewire_compact_cap,
+        "delivery": "pallas" if plan is not None else "xla",
     }
 
 
@@ -567,9 +596,15 @@ def main(argv: list[str] | None = None) -> int:
             mg1, "push_pull", 1, msg_slots=16, reps=reps, plan=mplan1,
             rewire_compact_cap=65536, **churn_kw,
         )
-        # config 5 + periodic re-materialization (topology lifecycle; see
-        # bench_churn_remat's docstring for why this is NOT a rate win)
-        configs["churn_rewire_1m_remat16"] = bench_churn_remat(dg1, reps=reps)
+        # config 5 + periodic re-materialization at its optimal operating
+        # point (VERDICT r4 item 4): kernel delivery + a compact cap sized
+        # for remat_every rounds of joiners (~1.8k/round at this churn), so
+        # the amortized figure prices the real long-horizon trade:
+        # base + O(cap) side paths + remat/remat_every
+        configs["churn_rewire_1m_remat_compact"] = bench_churn_remat(
+            dg1, reps=reps, remat_every=24, plan=plan1_k1,
+            rewire_compact_cap=49152,
+        )
         # BASELINE config 2: 1k peers + 3-miss liveness (detection latency
         # vs the reference's 30-42 s worst-case band, SURVEY.md §6)
         configs["liveness_1k"] = bench_liveness(reps=reps)
@@ -623,6 +658,24 @@ def main(argv: list[str] | None = None) -> int:
         # same fairness the flood pair below gets by freeing the plan first;
         # a resident plan inflates XLA round times via spill)
         ns_xla = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps)
+        # BASELINE configs 4-5 at north-star scale (VERDICT r4 item 6):
+        # SIR and churn were previously benched at 1M only. One rep each
+        # (10M rounds are seconds); xla entries run plan-free like ns_xla
+        sir10 = {
+            "xla": bench_one(
+                dg10, "push_pull", 1, msg_slots=16, reps=1,
+                sir_recover_rounds=8,
+            )
+        }
+        churn_kw10 = dict(
+            churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+            rewire_compact_cap=131072,
+        )
+        churn10 = {
+            "xla": bench_one(
+                dg10, "push_pull", 1, msg_slots=16, reps=1, **churn_kw10
+            )
+        }
         # plan build cold vs warm, mirroring setup_seconds_cold/warm: the
         # first build pays ~17 s of trace+compile, a rebuild is ~5 s of
         # device compute — e2e accounting uses the steady-state (warm)
@@ -631,6 +684,14 @@ def main(argv: list[str] | None = None) -> int:
         del plan10
         plan10, plan10_s = _build_plan(dg10, fanout=1, rows=1024, device=True)
         ns_pal = bench_one(dg10, "push_pull", 1, msg_slots=16, reps=reps, plan=plan10)
+        sir10["pallas"] = bench_one(
+            dg10, "push_pull", 1, msg_slots=16, reps=1, sir_recover_rounds=8,
+            plan=plan10,
+        )
+        churn10["pallas"] = bench_one(
+            dg10, "push_pull", 1, msg_slots=16, reps=1, plan=plan10,
+            **churn_kw10,
+        )
         # flood at north-star scale: the staircase kernel's strongest mode
         # (its all-edges streaming formulation), one rep each path. The
         # push_pull plan (~700 MB) is freed first: with it resident, XLA's
@@ -659,6 +720,14 @@ def main(argv: list[str] | None = None) -> int:
         )
         flood10["matching"] = bench_one(
             mg10, "flood", 1, msg_slots=16, reps=1, max_rounds=50, plan=mplan10
+        )
+        sir10["matching"] = bench_one(
+            mg10, "push_pull", 1, msg_slots=16, reps=1, sir_recover_rounds=8,
+            plan=mplan10,
+        )
+        churn10["matching"] = bench_one(
+            mg10, "push_pull", 1, msg_slots=16, reps=1, plan=mplan10,
+            **churn_kw10,
         )
         del mg10, mplan10
         # end-to-end cost per path: each path is charged EVERYTHING it needs
@@ -695,6 +764,8 @@ def main(argv: list[str] | None = None) -> int:
             ),
             "met": bool(min(e2e_xla, e2e_pal, e2e_match) < 60.0),
             "flood_10m": flood10,
+            "sir_10m": sir10,
+            "churn_10m": churn10,
         }
 
     if with_dist or not quick:

@@ -1,0 +1,112 @@
+"""Dedup collision semantics (VERDICT r4 item 5, docs/dedup_semantics.md):
+R > M conflation behaves as specified, the accounting helpers match
+empirical rates, and the k-hash Bloom mode trades conflation for the
+documented false-positive law."""
+
+import numpy as np
+
+from tpu_gossip.compat.simnet import SimCluster
+from tpu_gossip.compat.peer import PeerNode
+from tpu_gossip.core.state import message_slot, message_slots
+from tpu_gossip.sim.metrics import (
+    bloom_false_positive_rate,
+    expected_conflations,
+)
+
+
+def _cluster(n=40, msg_slots=8, **kw):
+    cluster = SimCluster(msg_slots=msg_slots, fanout=3, mode="push", **kw)
+    peers = [
+        PeerNode(f"10.0.0.{i}", 9000, transport="tpu-sim", cluster=cluster)
+        for i in range(n)
+    ]
+    cluster.materialize(m=3)
+    return cluster, peers
+
+
+def test_rumors_sharing_a_slot_are_conflated():
+    """R > M regime: two rumors in one slot are indistinguishable — seeing
+    one reads as having seen both. This IS the documented semantics."""
+    cluster, peers = _cluster()
+    m = 8
+    # find two distinct rumor ids that collide
+    a = "rumor-a"
+    b = next(
+        f"probe-{i}" for i in range(1000)
+        if f"probe-{i}" != a
+        and message_slot(f"probe-{i}", m) == message_slot(a, m)
+    )
+    peers[0].gossip(a)
+    assert peers[0].has_seen(a)
+    assert peers[0].has_seen(b)  # conflation: same slot
+    # a rumor in a DIFFERENT slot is not conflated
+    c = next(
+        f"probe2-{i}" for i in range(1000)
+        if message_slot(f"probe2-{i}", m) != message_slot(a, m)
+    )
+    assert not peers[0].has_seen(c)
+    # conflated rumors share one coverage curve
+    cluster.step(12)
+    assert cluster.coverage(a) == cluster.coverage(b) > 0.5
+
+
+def test_expected_conflations_matches_empirical():
+    m = 64
+    rng = np.random.default_rng(0)
+    trials = 400
+    for r in (8, 32, 128):
+        got = 0
+        for t in range(trials):
+            ids = rng.integers(0, 2**31, size=r)
+            slots = [message_slot(int(x) ^ (t << 40), m) for x in ids]
+            got += r - len(set(slots))
+        emp = got / trials
+        want = expected_conflations(r, m)
+        assert abs(emp - want) < max(0.25 * want, 0.6), (r, emp, want)
+
+
+def test_bloom_mode_false_positive_law():
+    """k=2 Bloom dedup: insert R rumors, measure P(novel rumor reads seen)
+    against the closed form."""
+    m, k, r = 64, 2, 20
+    cluster, peers = _cluster(n=6, msg_slots=m, dedup_hashes=k)
+    p = peers[0]
+    for i in range(r):
+        p.gossip(f"known-{i}")
+        assert p.has_seen(f"known-{i}")  # no false negatives, ever
+    probes = 2000
+    fp = sum(p.has_seen(f"novel-{j}") for j in range(probes)) / probes
+    want = bloom_false_positive_rate(r, m, k)
+    assert abs(fp - want) < 0.06, (fp, want)
+
+
+def test_bloom_mode_coverage_propagates():
+    """k=2 bits both propagate: coverage(text) under Bloom mode reaches the
+    swarm like single-slot mode does."""
+    cluster, peers = _cluster(n=40, msg_slots=64, dedup_hashes=2)
+    peers[0].gossip("hello-bloom")
+    cluster.step(15)
+    assert cluster.coverage("hello-bloom") > 0.9
+
+
+def test_message_slots_planes_are_distinct_hashes():
+    m = 4096
+    collide = sum(
+        len(set(message_slots(f"x-{i}", m, 2))) == 1 for i in range(2000)
+    )
+    # planes agree only at the ~1/M chance level
+    assert collide < 10
+
+
+def test_int_id_hash_planes_independent():
+    """Regression: an affine per-plane mix of integer ids cancels modulo a
+    power-of-two M, collapsing k>1 Bloom dedup to k=1 conflation. Integer
+    ids that collide in plane 0 must not systematically collide in plane 1."""
+    m = 16
+    base = message_slots(0, m, 2)
+    both = sum(
+        message_slots(i, m, 2) == base
+        for i in range(0, 16 * 400, 16)  # ids congruent mod M
+    )
+    # independent planes: P(both match) ~ 1/M per id; affine planes: all match
+    assert both < 60

@@ -259,10 +259,14 @@ def test_with_fanout_rebind_matches_build():
     )
     rebound = plan1.with_fanout(3)
     np.testing.assert_array_equal(
-        np.asarray(rebound.push_thresh), np.asarray(plan3.push_thresh)
+        np.asarray(rebound.push_threshold()), np.asarray(plan3.push_threshold())
     )
     np.testing.assert_array_equal(
-        np.asarray(rebound.pull_thresh), np.asarray(plan3.pull_thresh)
+        np.asarray(rebound.pull_threshold()), np.asarray(plan3.pull_threshold())
+    )
+    # and rebinding really changes the gate (fanout enters the law)
+    assert not np.array_equal(
+        np.asarray(plan1.push_threshold()), np.asarray(rebound.push_threshold())
     )
 
 
@@ -296,3 +300,36 @@ def test_engine_modes_on_matching_plan():
     fin2, stats2 = simulate(state2, cfg2, 12, plan)
     assert float(fin2.coverage(0)) > 0.3
     assert bool(jnp.any(fin2.rewired))
+
+
+def test_pairing_reach_spans_all_rows():
+    """Regression for the 10M banding bug: with too few transpose stages,
+    pairs can only form within ~128^K rows, turning the swarm into a 1-D
+    banded structure (measured: 64 rounds to 99% at 10M instead of ~16).
+    The stage count must scale so partner displacement spans the array."""
+    import math
+
+    from tpu_gossip.core.matching_topology import _build_plan, _plan_classes
+
+    r = 20480  # > 128^2/8: needs K=3
+    k = max(2, math.ceil(math.log(r) / math.log(128)))
+    assert k == 3
+    # synthetic degree-2 swarm exactly filling r rows: n*2 = r*128
+    n = r * 128 // 2
+    deg = np.full(n, 2, dtype=np.int32)
+    classes = _plan_classes(deg)
+    (lanes, m3, lanes_inv, valid, *_rest) = _build_plan(
+        jax.random.key(0), jnp.asarray(deg), n=n, rows=r, classes=classes,
+        fanout=None, interpret=True,
+    )
+    plan = MatchingPlan(
+        lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
+        deg_other=None, n=n, rows=r, classes=classes,
+    )
+    iota = jnp.arange(r * 128, dtype=jnp.int32).reshape(r, 128)
+    part = np.asarray(plan.partner(iota, interpret=True))
+    disp = np.abs(part // 128 - np.arange(r * 128).reshape(r, 128) // 128)
+    # sample rows across the array; median displacement must span rows
+    sample = disp[:: r // 97].ravel()
+    assert np.median(sample) > r / 8, np.median(sample)
+    assert sample.max() > r / 2

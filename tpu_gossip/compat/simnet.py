@@ -16,7 +16,9 @@ import jax
 import numpy as np
 
 from tpu_gossip.compat.wire import Addr
-from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm, message_slot
+from tpu_gossip.core.state import (
+    SwarmConfig, SwarmState, init_swarm, message_slot, message_slots,
+)
 from tpu_gossip.core.topology import build_csr, preferential_attachment
 from tpu_gossip.sim.engine import simulate
 
@@ -44,14 +46,22 @@ class SimCluster:
         fanout: int = 3,
         mode: str = "push",
         seed: int = 0,
+        dedup_hashes: int = 1,
         **config_kw,
     ) -> None:
+        if dedup_hashes < 1:
+            raise ValueError("dedup_hashes must be >= 1")
         self._addrs: list[Addr] = []
         self._ids: dict[Addr, int] = {}
         self._msg_slots = msg_slots
         self._fanout = fanout
         self._mode = mode
         self._seed = seed
+        # k > 1: Bloom-filter dedup over the same (N, M) bitmap — k hash
+        # planes per message (core.state.message_slots). Trades k=1's
+        # rumor conflation for the classic Bloom false-positive law; see
+        # docs/dedup_semantics.md
+        self._dedup_hashes = dedup_hashes
         self._config_kw = config_kw
         self._silent_pending: set[Addr] = set()
         self.cfg: SwarmConfig | None = None
@@ -114,18 +124,23 @@ class SimCluster:
 
     def gossip(self, addr: Addr, text: str) -> None:
         st = self._require_state()
-        slot = message_slot(text, self._msg_slots)
         i = self._id(addr)
-        st.seen = st.seen.at[i, slot].set(True)
-        # record first-infection round unless already infected (-1 = never;
-        # engine gates SIR recovery on infected_round >= 0; per-slot)
-        if int(st.infected_round[i, slot]) < 0:
-            st.infected_round = st.infected_round.at[i, slot].set(int(st.round))
+        for slot in message_slots(text, self._msg_slots, self._dedup_hashes):
+            st.seen = st.seen.at[i, slot].set(True)
+            # record first-infection round unless already infected (-1 =
+            # never; engine gates SIR recovery on infected_round >= 0)
+            if int(st.infected_round[i, slot]) < 0:
+                st.infected_round = st.infected_round.at[i, slot].set(
+                    int(st.round)
+                )
 
     def has_seen(self, addr: Addr, text: str) -> bool:
         st = self._require_state()
-        slot = message_slot(text, self._msg_slots)
-        return bool(st.seen[self._id(addr), slot])
+        i = self._id(addr)
+        return all(
+            bool(st.seen[i, slot])
+            for slot in message_slots(text, self._msg_slots, self._dedup_hashes)
+        )
 
     def set_silent(self, addr: Addr, value: bool) -> None:
         if self.state is None:
@@ -158,5 +173,11 @@ class SimCluster:
 
     def coverage(self, text: str) -> float:
         st = self._require_state()
-        slot = message_slot(text, self._msg_slots)
-        return float(st.coverage(slot))
+        slots = message_slots(text, self._msg_slots, self._dedup_hashes)
+        if len(slots) == 1:
+            return float(st.coverage(slots[0]))
+        import jax.numpy as jnp
+
+        live = st.alive & ~st.declared_dead
+        got = st.seen[:, jnp.asarray(slots)].all(axis=1) & live
+        return float(jnp.sum(got) / jnp.maximum(jnp.sum(live), 1))

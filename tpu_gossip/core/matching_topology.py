@@ -22,14 +22,19 @@ Model semantics (matching device_powerlaw_graph up to documented deltas):
   entirely from the pairing pipeline's random shuffle tables.
 - Stub layout: nodes relabelled degree-ascending and grouped into classes
   of equal PADDED degree (host-planned runs, pad waste capped at a few
-  percent), each node owning ``pad_deg`` consecutive slots of which the
-  first ``deg`` are real. Node ids are therefore degree-sorted — documented,
-  and benchmarks seed origins at ids 0..m-1, i.e. minimum-degree nodes
-  (the median degree of a power-law swarm), which is the conservative side.
+  percent). Within a class slots are POSITION-major — all nodes' i-th
+  stubs contiguous — so expand/reduce are wide (pad_deg, count) reshapes,
+  never TPU-tiling-hostile narrow arrays; a node's real stubs are its
+  entries in the first ``deg`` position planes. Node ids are degree-sorted
+  — documented, and benchmarks seed origins at ids 0..m-1, i.e.
+  minimum-degree nodes (the median degree of a power-law swarm), which is
+  the conservative side.
 - Pairing: slot j's partner is pi(j) for the involution
-  pi = L1·T·L2·T·M3·T^-1·L2^-1·T^-1·L1^-1 (M3 a per-row fixed-point-free
-  lane involution, L* random per-row lane permutations, T the transpose
-  bijection). pi has no fixed points, so every slot has a partner.
+  pi = sigma·M3·sigma^-1, sigma = L1·T·...·LK·T with K = ceil(log128(R))
+  transpose stages (M3 a per-row fixed-point-free lane involution, L*
+  random per-row lane permutations, T the transpose bijection). pi has no
+  fixed points, so every slot has a partner; K scales with R so pairing
+  reach covers the whole slot array (MatchingPlan.stages).
 - Erasure: a stub is erased when its partner is a padding slot, when the
   pair is a self-loop, or when the (u, v) edge is a duplicate (plan-time
   lexsort, exactly device_topology.py's rule) — both endpoints die, as in
@@ -70,77 +75,95 @@ class MatchingPlan:
 
     ``classes`` is a tuple of (node_off, slot_off, count, pad_deg) runs —
     all Python ints, so expand/reduce slicing is static. Lane tables are
-    int32 (R, 128); ``valid`` marks slots that survived erasure (a live
-    directed edge owner(j) <- owner(pi(j))); thresholds are uint32 Bernoulli
-    gates exactly like StaircasePlan's (pallas_segment.py).
+    int8 (int32 on sub-32-row-granularity small plans); ``valid`` marks
+    slots that survived erasure (a live directed edge
+    owner(j) <- owner(pi(j))). Sampling gates are COMPUTED per round from
+    ``deg_other``/``deg_real`` via :meth:`push_threshold` /
+    :meth:`pull_threshold` — same uint32 Bernoulli law as StaircasePlan's
+    precomputed tables (pallas_segment.bernoulli_threshold_device), without
+    their ~450 MB of 10M-scale residency.
     """
 
-    l1: jax.Array
-    l2: jax.Array
-    m3: jax.Array
-    l2i: jax.Array
-    l1i: jax.Array
+    lanes: tuple  # K lane tables (R, 128), one per transpose stage
+    m3: jax.Array  # per-row fixed-point-free lane involution (the pairing)
+    lanes_inv: tuple  # inverses of ``lanes``, same order
     valid: jax.Array  # bool (R, 128)
-    push_thresh: jax.Array | None  # uint32 (R, 128)
-    pull_thresh: jax.Array | None  # uint32 (R, 128)
+    deg_other: jax.Array | None  # int32 (R, 128) — partner's realized degree
     deg_real: jax.Array | None = None  # int32 (n,) post-erasure degrees
     n: int = dataclasses.field(default=0, metadata=dict(static=True))
     rows: int = dataclasses.field(default=0, metadata=dict(static=True))
     classes: tuple = dataclasses.field(default=(), metadata=dict(static=True))
     fanout: int | None = dataclasses.field(default=None, metadata=dict(static=True))
 
-    def with_fanout(self, fanout: int, *, interpret: bool | None = None):
-        """Rebind the sampling thresholds for a different ``fanout`` without
-        rebuilding the graph (the pairing and erasure are fanout-free)."""
-        if self.deg_real is None:
-            raise ValueError("plan carries no realized degrees")
-        deg_self = self.expand(self.deg_real)
-        deg_other = self.partner(deg_self, interpret=interpret)
-        push = jnp.where(
-            self.valid & (deg_other > 0),
+    def with_fanout(self, fanout: int):
+        """Rebind the sampling fanout — free: thresholds are computed
+        elementwise per round from ``deg_other``/``deg_real`` (the firing
+        law lives once, in kernels/matching.py; storing precomputed uint32
+        threshold tables instead would cost ~450 MB of HBM residency at the
+        10M north star — the difference between fitting and OOM)."""
+        if self.deg_other is None:
+            raise ValueError("plan carries no partner degrees")
+        return dataclasses.replace(self, fanout=fanout)
+
+    def push_threshold(self, fanout: int | None = None) -> jax.Array:
+        """Per-slot uint32 push gate: B(fanout/deg(sender)), 0 off-edge."""
+        f = self.fanout if fanout is None else fanout
+        return jnp.where(
+            self.valid & (self.deg_other > 0),
             bernoulli_threshold_device(
-                fanout / jnp.maximum(deg_other, 1).astype(jnp.float32)
+                f / jnp.maximum(self.deg_other, 1).astype(jnp.float32)
             ),
             jnp.uint32(0),
         )
-        pull = jnp.where(
+
+    def pull_threshold(self) -> jax.Array:
+        """Per-slot uint32 pull gate: B(1/deg(puller)), 0 off-edge."""
+        deg_self = self.expand(self.deg_real)
+        return jnp.where(
             self.valid & (deg_self > 0),
             bernoulli_threshold_device(
                 1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)
             ),
             jnp.uint32(0),
         )
-        return dataclasses.replace(
-            self, push_thresh=push, pull_thresh=pull, fanout=fanout
-        )
 
     @property
     def stages(self) -> tuple:
-        """The pairing involution as a data-op pipeline (permute.py)."""
-        return (
-            ("lane", self.l1),
-            ("t",),
-            ("lane", self.l2),
-            ("t",),
-            ("lane", self.m3),
-            ("tinv",),
-            ("lane", self.l2i),
-            ("tinv",),
-            ("lane", self.l1i),
-        )
+        """The pairing involution pi = sigma . M3 . sigma^-1 as a data-op
+        pipeline (permute.py), sigma = L1.T.L2.T...Lk.T with K = len(lanes)
+        transpose stages. K must satisfy 128^K >= rows: each [L, T] stage
+        multiplies the set of rows a slot's pairing candidates can come
+        from by 128, so fewer stages leave the matching BANDED — pairs
+        only within ~128^K rows — which at the 10M scale (R=435k, K=2)
+        measured as 64 rounds to 99% coverage instead of ~16.
+        """
+        fwd = []
+        for ln in self.lanes:
+            fwd += [("lane", ln), ("t",)]
+        bwd = []
+        for ln in reversed(self.lanes_inv):
+            bwd += [("tinv",), ("lane", ln)]
+        return tuple(fwd) + (("lane", self.m3),) + tuple(bwd)
 
     def partner(self, x: jax.Array, *, interpret: bool | None = None) -> jax.Array:
         """out[j] = x[pi(j)] over (R, 128) slot data — ONE pipeline pass."""
         return apply_pipeline(x, self.stages, interpret=interpret)
 
     def expand(self, x_n: jax.Array) -> jax.Array:
-        """Broadcast per-node values (n,) onto slots (R, 128) — no gather."""
+        """Broadcast per-node values (n,) onto slots (R, 128) — no gather.
+
+        Classes store slots POSITION-major: all of a class's nodes' i-th
+        stubs are contiguous, so expansion is a wide (pad_deg, count)
+        broadcast and reduction a wide reshape — never a (count, pad_deg)
+        array, whose tiny trailing dim TPU tiling pads 128-wide (measured
+        as a 64x / 13 GB HLO-temp explosion at the 10M north star).
+        """
         pieces = []
         for node_off, _slot_off, count, pad_deg in self.classes:
             pieces.append(
                 jnp.broadcast_to(
-                    jax.lax.dynamic_slice_in_dim(x_n, node_off, count)[:, None],
-                    (count, pad_deg),
+                    jax.lax.dynamic_slice_in_dim(x_n, node_off, count)[None, :],
+                    (pad_deg, count),
                 ).reshape(-1)
             )
         flat = jnp.concatenate(pieces)
@@ -153,17 +176,20 @@ class MatchingPlan:
         """Fold slot values (R, 128) into per-node values (n,) — no scatter.
 
         ``op``: "or" (bitwise, delivery words) or "sum" (billing counts).
+        Position-major classes make this a (pad_deg, count) reshape + an
+        axis-0 reduction — wide in the populous (small-degree) classes
+        where the volume is, tiny in absolute terms for hub classes.
         """
         flat = slots.reshape(-1)
         outs = []
         for _node_off, slot_off, count, pad_deg in self.classes:
             block = jax.lax.dynamic_slice_in_dim(
                 flat, slot_off, count * pad_deg
-            ).reshape(count, pad_deg)
+            ).reshape(pad_deg, count)
             if op == "or":
-                outs.append(jnp.bitwise_or.reduce(block, axis=1))
+                outs.append(jnp.bitwise_or.reduce(block, axis=0))
             else:
-                outs.append(jnp.sum(block, axis=1, dtype=slots.dtype))
+                outs.append(jnp.sum(block, axis=0, dtype=slots.dtype))
         return jnp.concatenate(outs)
 
 
@@ -198,7 +224,7 @@ def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "rows", "classes", "fanout", "interpret")
+    jax.jit, static_argnames=("n", "rows", "classes", "interpret")
 )
 def _build_plan(
     key,
@@ -207,16 +233,24 @@ def _build_plan(
     n: int,
     rows: int,
     classes: tuple,
-    fanout: int | None,
     interpret: bool | None,
 ):
     r = rows
-    k1, k2, k3 = jax.random.split(key, 3)
+    # mixing depth: 128^K must reach every row or the matching is banded
+    # (see MatchingPlan.stages); K=2 suffices to ~2M slots, 10M needs 3
+    n_stages = max(2, math.ceil(math.log(max(r, 2)) / math.log(128)))
+    keys = jax.random.split(key, n_stages + 1)
 
-    # --- random stage tables --------------------------------------------
-    l1 = jnp.argsort(jax.random.uniform(k1, (r, 128)), axis=1).astype(jnp.int32)
-    l2 = jnp.argsort(jax.random.uniform(k2, (r, 128)), axis=1).astype(jnp.int32)
-    p = jnp.argsort(jax.random.uniform(k3, (r, 128)), axis=1).astype(jnp.int32)
+    # --- random stage tables (int8 when the 32-row granularity allows:
+    # lane ids < 128; at 10M each int32 table would cost 223 MB of HBM) ---
+    tdt = jnp.int8 if r % 32 == 0 else jnp.int32
+    lanes = tuple(
+        jnp.argsort(jax.random.uniform(keys[i], (r, 128)), axis=1).astype(tdt)
+        for i in range(n_stages)
+    )
+    p = jnp.argsort(
+        jax.random.uniform(keys[n_stages], (r, 128)), axis=1
+    ).astype(jnp.int32)
     a, b = p[:, 0::2], p[:, 1::2]
     rows_ix = jnp.arange(r, dtype=jnp.int32)[:, None]
     m3 = (
@@ -225,14 +259,12 @@ def _build_plan(
         .set(b)
         .at[rows_ix, b]
         .set(a)
-    )
-    l1i = inverse_tables(l1)
-    l2i = inverse_tables(l2)
+    ).astype(tdt)
+    lanes_inv = tuple(inverse_tables(ln) for ln in lanes)
 
     plan0 = MatchingPlan(
-        l1=l1, l2=l2, m3=m3, l2i=l2i, l1i=l1i,
-        valid=jnp.zeros((r, 128), bool),
-        push_thresh=None, pull_thresh=None,
+        lanes=lanes, m3=m3, lanes_inv=lanes_inv,
+        valid=jnp.zeros((r, 128), bool), deg_other=None,
         n=n, rows=r, classes=classes, fanout=None,
     )
 
@@ -243,8 +275,8 @@ def _build_plan(
     owner = jnp.where(in_layout, owner, n)  # tail pad -> sentinel
     real = jnp.zeros((r * 128,), bool)
     for node_off, slot_off, count, pad_deg in classes:
-        pos = jnp.arange(pad_deg, dtype=jnp.int32)[None, :]
-        d = jax.lax.dynamic_slice_in_dim(deg, node_off, count)[:, None]
+        pos = jnp.arange(pad_deg, dtype=jnp.int32)[:, None]
+        d = jax.lax.dynamic_slice_in_dim(deg, node_off, count)[None, :]
         real = jax.lax.dynamic_update_slice_in_dim(
             real, (pos < d).reshape(-1), slot_off, axis=0
         )
@@ -276,9 +308,10 @@ def _build_plan(
     dup_both = dup | (plan0.partner(dup.astype(jnp.int32), interpret=interpret) > 0)
     valid = alive & ~dup_both
 
-    # --- realized degrees (thresholds are bound by with_fanout below, the
-    # ONE place the firing law lives) -------------------------------------
+    # --- realized degrees + partner degrees (thresholds are computed
+    # elementwise per round from these — no resident threshold tables) ----
     deg_real = plan0.reduce(valid.astype(jnp.int32), op="sum")
+    deg_other = plan0.partner(plan0.expand(deg_real), interpret=interpret)
 
     # --- CSR export (sentinel-row form, device_topology.py:152-161) ------
     src = jnp.where(valid, owner, n).reshape(-1)
@@ -291,7 +324,8 @@ def _build_plan(
     exists = jnp.arange(n + 1, dtype=jnp.int32) < n
 
     return (
-        l1, l2, m3, l2i, l1i, valid, deg_real, row_ptr, col_idx, exists,
+        lanes, m3, lanes_inv, valid, deg_other, deg_real, row_ptr, col_idx,
+        exists,
     )
 
 
@@ -310,8 +344,10 @@ def matching_powerlaw_graph(
     Returns ``(graph, plan)``: ``graph`` is a sentinel-row DeviceGraph (feed
     to ``init_swarm`` exactly like device_powerlaw_graph's) and ``plan`` the
     MatchingPlan whose pipeline delivers rounds gather-free
-    (kernels/matching.py). With ``fanout``, sampled-delivery thresholds are
-    precomputed (same law as build_staircase_plan's).
+    (kernels/matching.py). ``fanout`` only binds the plan's static sampling
+    rate — the uint32 gates themselves are computed per round from the
+    plan's degree tables (push_threshold/pull_threshold, same law as
+    build_staircase_plan's precomputed tables).
     """
     if key is None:
         key = jax.random.key(0)
@@ -320,22 +356,24 @@ def matching_powerlaw_graph(
     deg_host = quantile_degrees(n, gamma, d_min, d_max)
     classes = _plan_classes(deg_host)
     n_slots = sum(c * w for _, _, c, w in classes)
-    # rows hug the real stub count (granularity 8 rows = 1024 slots): the
-    # dead tail pairs with real stubs and erases them, so it must stay tiny
-    rows = math.ceil(n_slots / (128 * 8)) * 8
+    # rows hug the real stub count: the dead tail pairs with real stubs and
+    # erases them, so it must stay tiny relative to n_slots. Large plans use
+    # 32-row granularity (<= 4095 dead slots, sub-0.8%) which unlocks int8
+    # stage tables (the (32, 128) narrow tile); small plans keep 8-row
+    # granularity with int32 tables so the tail stays a rounding error
+    gran = 32 if n_slots >= (1 << 19) else 8
+    rows = math.ceil(n_slots / (128 * gran)) * gran
     deg = jnp.asarray(deg_host)
     (
-        l1, l2, m3, l2i, l1i, valid, deg_real, row_ptr, col_idx, exists,
+        lanes, m3, lanes_inv, valid, deg_other, deg_real, row_ptr, col_idx,
+        exists,
     ) = _build_plan(
-        key, deg, n=n, rows=rows, classes=classes, fanout=fanout,
-        interpret=interpret,
+        key, deg, n=n, rows=rows, classes=classes, interpret=interpret,
     )
     plan = MatchingPlan(
-        l1=l1, l2=l2, m3=m3, l2i=l2i, l1i=l1i, valid=valid,
-        push_thresh=None, pull_thresh=None, deg_real=deg_real,
-        n=n, rows=rows, classes=classes, fanout=None,
+        lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
+        deg_other=deg_other, deg_real=deg_real,
+        n=n, rows=rows, classes=classes, fanout=fanout,
     )
-    if fanout is not None:
-        plan = plan.with_fanout(fanout, interpret=interpret)
     graph = DeviceGraph(row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n)
     return graph, plan

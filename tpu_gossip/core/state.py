@@ -43,6 +43,7 @@ __all__ = [
     "SwarmState",
     "init_swarm",
     "message_slot",
+    "message_slots",
     "save_swarm",
     "load_swarm",
 ]
@@ -196,14 +197,49 @@ def message_slot(message_id: int | str, msg_slots: int) -> int:
 
     Stable across runs (unlike Python's salted ``hash``) so socket-mode and
     tpu-sim runs agree on slots for conformance tests.
+
+    SLOT-SHARING IS THE INTENDED SEMANTICS past capacity: with R distinct
+    rumors over M slots, two rumors hashing to one slot are conflated — a
+    peer holding one is indistinguishable from holding both. Dedup is exact
+    whenever the active rumors occupy distinct slots (guaranteed by seeding
+    via ``origin_slots``; probabilistic otherwise — the expected conflation
+    count is ``sim.metrics.expected_conflations(R, M)``). For many-rumor
+    swarms use ``message_slots(..., k>1)``: a k-hash Bloom view over the
+    same (N, M) bitmap. See docs/dedup_semantics.md for the math and the
+    measured rates.
     """
-    if isinstance(message_id, str):
-        h = 2166136261
-        for b in message_id.encode():
+    return message_slots(message_id, msg_slots, 1)[0]
+
+
+def message_slots(
+    message_id: int | str, msg_slots: int, k: int = 1
+) -> tuple[int, ...]:
+    """k dedup slots for one message — the Bloom-filter view (k > 1).
+
+    Plane i uses FNV-1a seeded by i, so planes are independent hashes over
+    the SAME (N, M) bitmap: insert sets all k bits, membership tests all k.
+    False positives (a novel rumor reading as seen) occur at the classic
+    Bloom rate ~(1 - e^(-kR/M))^k for R distinct rumors; false negatives
+    never. k=1 degrades to plain slot hashing (conflation instead of FPs).
+    """
+    if k <= 0 or k > msg_slots:
+        raise ValueError(f"k must be in [1, msg_slots]; got {k}")
+    # int ids hash through the same seeded FNV over their bytes: an affine
+    # per-plane mix (id + plane*c) * c' is NOT independent across planes —
+    # for power-of-two M the plane offset cancels and k>1 degenerates to
+    # k=1 conflation for integer ids
+    data = (
+        message_id.encode()
+        if isinstance(message_id, str)
+        else int(message_id).to_bytes(8, "little", signed=True)
+    )
+    out = []
+    for plane in range(k):
+        h = (2166136261 ^ (plane * 0x9E3779B9)) & 0xFFFFFFFF
+        for b in data:
             h = ((h ^ b) * 16777619) & 0xFFFFFFFF
-    else:
-        h = (int(message_id) * 2654435761) & 0xFFFFFFFF
-    return h % msg_slots
+        out.append(h % msg_slots)
+    return tuple(out)
 
 
 def init_swarm(

@@ -98,8 +98,8 @@ def matching_sampled(
     ``(incoming (n_state, m) bool, msgs_sent int32 scalar)``. Edge-level
     activation is drawn once and shared across 32-slot word groups.
     """
-    if plan.push_thresh is None:
-        raise ValueError("plan built without fanout — no sampling thresholds")
+    if plan.fanout is None or plan.deg_other is None:
+        raise ValueError("plan built without fanout — no sampling gates")
     n_state = transmit.shape[0]
     shape = (plan.rows, 128)
     k_push, k_pull = jax.random.split(key)
@@ -110,10 +110,16 @@ def matching_sampled(
         rec_slots = plan.expand(rec_rows_n.astype(jnp.int32)) > 0
     active_p = active_q = None
     pull_bill = None
+    # gates computed elementwise from the plan's degree tables — storing
+    # precomputed uint32 thresholds would cost ~450 MB at the 10M north star
     if do_push:
-        active_p = jax.random.bits(k_push, shape, jnp.uint32) < plan.push_thresh
+        active_p = (
+            jax.random.bits(k_push, shape, jnp.uint32) < plan.push_threshold()
+        )
     if do_pull:
-        active_q = jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_thresh
+        active_q = (
+            jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_threshold()
+        )
         pull_bill = active_q.astype(jnp.int32)
     outs = []
     for lo, w in _slot_groups(m):

@@ -87,7 +87,10 @@ def lane_shuffle(
     r = x.shape[0]
     if r % 8 != 0:
         raise ValueError(f"rows {r} not a multiple of 8")
-    idx = idx.astype(jnp.int32)
+    if idx.dtype == jnp.int8 and r % 32 != 0:
+        # int8 sublane tiling is (32, 128); narrow tables require 32-row
+        # granularity (matching_topology sizes large plans that way)
+        raise ValueError(f"int8 index tables need rows % 32 == 0, got {r}")
     r0 = (r // BLOCK_ROWS) * BLOCK_ROWS
     parts = []
     if r0:
@@ -114,8 +117,10 @@ def untranspose_pass(x: jax.Array) -> jax.Array:
 
 
 def inverse_tables(idx: jax.Array) -> jax.Array:
-    """Per-row inverse permutation table, plan-time."""
-    return jnp.argsort(idx.astype(jnp.int32), axis=1).astype(jnp.int32)
+    """Per-row inverse permutation table, plan-time (dtype-preserving: int8
+    tables quarter their HBM traffic and, at 10M scale, ~840 MB of plan
+    residency — the margin between fitting in HBM and not)."""
+    return jnp.argsort(idx.astype(jnp.int32), axis=1).astype(idx.dtype)
 
 
 def apply_pipeline(

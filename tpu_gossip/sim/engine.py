@@ -135,7 +135,11 @@ def _disseminate_local(
     k_pull, k_rw_pull = jax.random.split(k_pull)
     sampled_kernel = (
         plan is not None
-        and getattr(plan, "push_thresh", None) is not None
+        and (
+            getattr(plan, "push_thresh", None) is not None  # StaircasePlan
+            or getattr(plan, "deg_other", None) is not None  # MatchingPlan
+        )
+        and getattr(plan, "fanout", None) is not None
         and cfg.mode in ("push", "push_pull")
     )
     if sampled_kernel:

@@ -22,6 +22,8 @@ from tpu_gossip.core.state import SwarmConfig, SwarmState
 from tpu_gossip.sim.engine import RoundStats, run_until_coverage, simulate
 
 __all__ = [
+    "expected_conflations",
+    "bloom_false_positive_rate",
     "BenchResult",
     "rounds_to_coverage",
     "coverage_curve",
@@ -148,3 +150,33 @@ def run_with_metrics(
     if sink is not None:
         write_jsonl(stats, sink)
     return fin, stats
+
+
+def expected_conflations(n_rumors: int, msg_slots: int) -> float:
+    """Expected number of rumors sharing a slot with an earlier rumor.
+
+    k=1 hash-slot dedup conflates rumors that collide: with R rumors
+    uniformly hashed over M slots, E[occupied slots] = M(1-(1-1/M)^R), so
+    E[conflated rumors] = R - M(1-(1-1/M)^R) — ~R^2/2M for R << M, 0 when
+    slots are assigned distinct (``origin_slots`` seeding). Use this to
+    size ``msg_slots`` (or switch to ``message_slots(k>1)`` Bloom dedup)
+    for a target conflation budget. See docs/dedup_semantics.md.
+    """
+    if n_rumors <= 0:
+        return 0.0
+    m = float(msg_slots)
+    return n_rumors - m * (1.0 - (1.0 - 1.0 / m) ** n_rumors)
+
+
+def bloom_false_positive_rate(
+    n_rumors: int, msg_slots: int, hashes: int
+) -> float:
+    """P(a NOVEL rumor reads as already-seen) under k-hash Bloom dedup
+    (core.state.message_slots): (1-(1-1/M)^(kR))^k. False negatives never
+    occur; a false positive suppresses a genuinely-new rumor at ingestion
+    (the classic Bloom trade, docs/dedup_semantics.md)."""
+    if n_rumors <= 0:
+        return 0.0
+    m = float(msg_slots)
+    fill = 1.0 - (1.0 - 1.0 / m) ** (hashes * n_rumors)
+    return fill ** hashes
