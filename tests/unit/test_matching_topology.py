@@ -320,7 +320,7 @@ def test_pairing_reach_spans_all_rows():
     classes = _plan_classes(deg)
     (lanes, m3, lanes_inv, valid, *_rest) = _build_plan(
         jax.random.key(0), jnp.asarray(deg), n=n, rows=r, classes=classes,
-        fanout=None, interpret=True,
+        interpret=True,
     )
     plan = MatchingPlan(
         lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
