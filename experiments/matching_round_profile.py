@@ -1,0 +1,112 @@
+"""Slope-timed stage decomposition of the 1M matching round: where do the
+~21 ms/round of the recorded headline go, given the permutation pipeline
+itself costs ~1 ms? Candidates: per-round threshold/gate computation (the
+expand is a 134-slice concat), the second pipeline for rec_slots, the
+protocol tail, RNG, or while_loop condition overhead."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+from tpu_gossip.core.state import SwarmConfig, init_swarm
+from tpu_gossip.kernels.matching import matching_sampled
+from tpu_gossip.sim.engine import gossip_round, simulate
+
+
+def slope(body, carry, n1, n2, reps=3):
+    def run(iters):
+        f = jax.jit(lambda c: jax.lax.fori_loop(0, iters, body, c))
+        out = f(carry)
+        _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = f(carry)
+            _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return (run(n2) - run(n1)) / (n2 - n1)
+
+
+def main():
+    n = 1_000_000
+    g, plan = matching_powerlaw_graph(n, gamma=2.5, fanout=1, key=jax.random.key(0))
+    cfg = SwarmConfig(n_peers=n + 1, msg_slots=16, mode="push_pull", fanout=1)
+    state = init_swarm(
+        g.as_padded_graph(), cfg, origins=np.arange(16),
+        origin_slots=np.arange(16), exists=g.exists,
+    )
+    # mid-epidemic state for realistic density
+    state, _ = simulate(state, cfg, 6, plan)
+    tx = state.seen
+    rec = state.alive
+
+    def t_expand(i, c):
+        return c ^ jnp.sum(
+            plan.expand(jnp.full((n,), i, jnp.int32)), dtype=jnp.int32
+        )
+
+    def t_partner(i, c):
+        return c ^ jnp.sum(
+            plan.partner(jnp.full((plan.rows, 128), i, jnp.int32)),
+            dtype=jnp.int32,
+        )
+
+    def t_reduce(i, c):
+        return c ^ jnp.sum(
+            plan.reduce(jnp.full((plan.rows, 128), i, jnp.int32), "or"),
+            dtype=jnp.int32,
+        )
+
+    def t_push_gate(i, c):
+        return c ^ jnp.sum(plan.push_threshold().astype(jnp.int32) + i, dtype=jnp.int32)
+
+    def t_pull_gate(i, c):
+        return c ^ jnp.sum(plan.pull_threshold().astype(jnp.int32) + i, dtype=jnp.int32)
+
+    def t_rng(i, c):
+        k = jax.random.fold_in(jax.random.key(0), i)
+        return c ^ jnp.sum(
+            jax.random.bits(k, (plan.rows, 128), jnp.uint32).astype(jnp.int32),
+            dtype=jnp.int32,
+        )
+
+    def t_delivery(i, c):
+        k = jax.random.fold_in(jax.random.key(1), i)
+        inc, msgs = matching_sampled(
+            plan, tx, None, 16, k, receptive_rows=rec,
+            do_push=True, do_pull=True,
+        )
+        return c ^ msgs
+
+    st0 = state
+
+    def t_round(i, c):
+        nonlocal_state = jax.lax.cond(
+            i >= 0, lambda s: s, lambda s: s, c
+        )
+        nxt, stats = gossip_round(nonlocal_state, cfg, plan)
+        return nxt
+
+    for name, body, carry, n1, n2 in [
+        ("expand (n->slots)", t_expand, jnp.int32(0), 8, 88),
+        ("partner pipeline", t_partner, jnp.int32(0), 8, 88),
+        ("reduce (slots->n)", t_reduce, jnp.int32(0), 8, 88),
+        ("push gate", t_push_gate, jnp.int32(0), 8, 88),
+        ("pull gate", t_pull_gate, jnp.int32(0), 8, 88),
+        ("rng draw", t_rng, jnp.int32(0), 8, 88),
+        ("matching_sampled full", t_delivery, jnp.int32(0), 4, 44),
+        ("full gossip_round", t_round, st0, 4, 44),
+    ]:
+        dt = slope(body, carry, n1, n2)
+        print(f"{name:24s} {dt*1e3:7.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -333,3 +333,29 @@ def test_pairing_reach_spans_all_rows():
     sample = disp[:: r // 97].ravel()
     assert np.median(sample) > r / 8, np.median(sample)
     assert sample.max() > r / 2
+
+
+def test_build_without_csr_export_runs_dissemination():
+    """export_csr=False: degree-true row_ptr, empty neighbor list, and the
+    full matching round (push_pull + SIR + liveness) still runs — churn
+    re-wiring configs are the ones that need the export."""
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.sim.engine import simulate
+
+    graph, plan = matching_powerlaw_graph(
+        2500, key=jax.random.key(2), fanout=1, export_csr=False
+    )
+    assert graph.col_idx.shape == (1,)
+    np.testing.assert_array_equal(
+        np.asarray(graph.row_ptr[1 : plan.n + 1] - graph.row_ptr[: plan.n]),
+        np.asarray(plan.deg_real),
+    )
+    cfg = SwarmConfig(
+        n_peers=plan.n + 1, msg_slots=4, mode="push_pull", fanout=1,
+        sir_recover_rounds=5,
+    )
+    state = init_swarm(
+        graph.as_padded_graph(), cfg, origins=[0], exists=graph.exists
+    )
+    fin, _ = simulate(state, cfg, 14, plan)
+    assert float(fin.coverage(0)) > 0.5

@@ -224,7 +224,7 @@ def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "rows", "classes", "interpret")
+    jax.jit, static_argnames=("n", "rows", "classes", "interpret", "export_csr")
 )
 def _build_plan(
     key,
@@ -234,6 +234,7 @@ def _build_plan(
     rows: int,
     classes: tuple,
     interpret: bool | None,
+    export_csr: bool = True,
 ):
     r = rows
     # mixing depth: 128^K must reach every row or the matching is banded
@@ -314,13 +315,28 @@ def _build_plan(
     deg_other = plan0.partner(plan0.expand(deg_real), interpret=interpret)
 
     # --- CSR export (sentinel-row form, device_topology.py:152-161) ------
-    src = jnp.where(valid, owner, n).reshape(-1)
-    dst = jnp.where(valid, other_owner, n).reshape(-1)
-    csr_order = jnp.argsort(src)
-    col_idx = dst[csr_order]
-    row_ptr = jnp.searchsorted(
-        src[csr_order], jnp.arange(n + 2, dtype=jnp.int32), side="left"
-    ).astype(jnp.int32)
+    # optional: the matching delivery, liveness, and SIR never read the
+    # CSR — only churn re-wiring draws and the XLA twin paths do — and the
+    # two ~D-element sorts here dominate the 10M build (VERDICT-grade
+    # north-star accounting charges only what the config needs)
+    if export_csr:
+        src = jnp.where(valid, owner, n).reshape(-1)
+        dst = jnp.where(valid, other_owner, n).reshape(-1)
+        csr_order = jnp.argsort(src)
+        col_idx = dst[csr_order]
+        row_ptr = jnp.searchsorted(
+            src[csr_order], jnp.arange(n + 2, dtype=jnp.int32), side="left"
+        ).astype(jnp.int32)
+    else:
+        # degree-true row_ptr (state consumers read degrees off it) with an
+        # empty neighbor list; rewire draws would index col_idx, so
+        # engine configs with rewire_slots > 0 must export the CSR
+        row_ptr = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(deg_real, dtype=jnp.int32),
+        ])
+        row_ptr = jnp.concatenate([row_ptr, row_ptr[-1:]])  # sentinel row
+        col_idx = jnp.zeros((1,), jnp.int32)
     exists = jnp.arange(n + 1, dtype=jnp.int32) < n
 
     return (
@@ -338,6 +354,7 @@ def matching_powerlaw_graph(
     fanout: int | None = None,
     key: jax.Array | None = None,
     interpret: bool | None = None,
+    export_csr: bool = True,
 ) -> tuple[DeviceGraph, MatchingPlan]:
     """Build the structured-matching power-law swarm on device.
 
@@ -347,7 +364,10 @@ def matching_powerlaw_graph(
     (kernels/matching.py). ``fanout`` only binds the plan's static sampling
     rate — the uint32 gates themselves are computed per round from the
     plan's degree tables (push_threshold/pull_threshold, same law as
-    build_staircase_plan's precomputed tables).
+    build_staircase_plan's precomputed tables). ``export_csr=False`` skips
+    the CSR sorts (the build's dominant cost at 10M) for configs that never
+    read it — pure dissemination/SIR/liveness on the matching path; churn
+    re-wiring and the XLA twin paths REQUIRE the export.
     """
     if key is None:
         key = jax.random.key(0)
@@ -369,6 +389,7 @@ def matching_powerlaw_graph(
         exists,
     ) = _build_plan(
         key, deg, n=n, rows=rows, classes=classes, interpret=interpret,
+        export_csr=export_csr,
     )
     plan = MatchingPlan(
         lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,

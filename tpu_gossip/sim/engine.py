@@ -178,6 +178,7 @@ def _disseminate_local(
             msgs_sent = msgs_sent + fresh_msgs
         return incoming, msgs_sent
     if cfg.mode in ("push", "push_pull"):
+        _require_csr(state, "XLA sampled delivery")
         tgt, valid = sample_fanout_targets(
             k_push, state.row_ptr, state.col_idx, cfg.fanout
         )
@@ -229,6 +230,7 @@ def _disseminate_local(
             else:
                 incoming = incoming | segment_or(plan, transmit, cfg.msg_slots)
         else:
+            _require_csr(state, "XLA flood delivery")
             incoming = incoming | flood_all(transmit, state.row_ptr, state.col_idx)
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
         msgs_sent = msgs_sent + jnp.sum(transmit.sum(-1, dtype=jnp.int32) * deg)
@@ -546,6 +548,16 @@ def _substitute_rewired(
     )
 
 
+def _require_csr(state: SwarmState, what: str) -> None:
+    if state.col_idx.shape[0] <= 1 and state.row_ptr.shape[0] > 3:
+        raise ValueError(
+            f"{what} reads the CSR neighbor list, but this graph was built "
+            "without one (matching_powerlaw_graph(export_csr=False)) — XLA "
+            "would silently clamp the out-of-bounds gathers; rebuild with "
+            "export_csr=True or deliver via the matching plan"
+        )
+
+
 def validate_rewire_width(state: SwarmState, cfg: SwarmConfig) -> None:
     """Fail loudly when a checkpoint's rewire_targets is narrower than
     ``cfg.rewire_slots`` — otherwise take_along_axis clamps the slot index
@@ -556,6 +568,18 @@ def validate_rewire_width(state: SwarmState, cfg: SwarmConfig) -> None:
             f"rewire_targets width {state.rewire_targets.shape[1]} — the "
             "checkpoint was saved with fewer slots; pad rewire_targets or "
             "lower rewire_slots"
+        )
+    if cfg.rewire_slots > 0 and cfg.churn_join_prob > 0 and (
+        state.col_idx.shape[0] <= 1
+    ):
+        # a CSR-free graph (matching_powerlaw_graph(export_csr=False))
+        # carries a 1-entry col_idx; the degree-preferential endpoint draws
+        # would gather out of bounds, which XLA silently CLAMPS to entry 0
+        # — every rejoiner would attach to peer 0 with no error raised
+        raise ValueError(
+            "churn re-wiring needs the neighbor list: this graph was built "
+            "without a CSR export (matching_powerlaw_graph(export_csr="
+            "False)); rebuild with export_csr=True"
         )
 
 
