@@ -228,9 +228,13 @@ def bench_one(
         key=jax.random.key(0),
     )
     res, _ = bench_swarm(state, cfg, 0.99, max_rounds, reps=reps, plan=plan)
-    # degree-true edge count in both CSR and CSR-free builds (row_ptr[-2]
-    # closes the real rows; col_idx.shape would read 1 for lean builds)
-    acc = _accesses_per_round(cfg, int(dg.row_ptr[-2]))
+    # XLA flood touches every col_idx slot (erased ones included), so use
+    # the real array length when a CSR exists; CSR-free builds (col_idx
+    # (1,)) fall back to the degree-true row_ptr span
+    n_edges = int(dg.col_idx.shape[0])
+    if n_edges <= 1:
+        n_edges = int(dg.row_ptr[-2])
+    acc = _accesses_per_round(cfg, n_edges)
     if plan is None:
         delivery = "xla"
     elif isinstance(plan, MatchingPlan):

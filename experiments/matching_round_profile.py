@@ -83,7 +83,9 @@ def main():
             plan, tx, None, 16, k, receptive_rows=rec,
             do_push=True, do_pull=True,
         )
-        return c ^ msgs
+        # keep the delivery fold live — msgs alone does not depend on the
+        # reduce/unpack half and XLA would dead-code-eliminate it
+        return c ^ msgs ^ jnp.sum(inc, dtype=jnp.int32)
 
     st0 = state
 

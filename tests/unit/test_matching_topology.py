@@ -85,13 +85,23 @@ def test_quantile_degrees_match_law():
 def test_classes_cover_and_pad_lightly():
     deg = quantile_degrees(50_000, 2.5, 2, 224)
     classes = _plan_classes(deg)
-    total_nodes = sum(c for _, _, c, _ in classes)
+    total_nodes = sum(c for _, _, c, _, _ in classes)
     assert total_nodes == 50_000
     real = int(deg.sum())
-    padded = sum(c * w for _, _, c, w in classes)
+    padded = sum(c * w for _, _, c, w, _ in classes)
     assert real <= padded <= real * 1.08
-    for (i, _, c, w) in classes:
+    aligned_off_ok = True
+    for (i, off, c, w, cs) in classes:
         assert (deg[i : i + c] <= w).all()
+        # populous classes 1024-align their plane stride (Pallas fold
+        # blocks); hub classes stay exact (alignment would multiply their
+        # span ~1024/count-fold)
+        if c >= 8192:
+            assert c <= cs < c + 1024 and cs % 1024 == 0
+            aligned_off_ok &= off % 1024 == 0
+        else:
+            assert cs == c
+    assert aligned_off_ok  # aligned classes lead the slot layout
 
 
 def test_exported_csr_is_consistent():

@@ -62,10 +62,19 @@ import numpy as np
 
 from tpu_gossip.core.device_topology import DeviceGraph
 from tpu_gossip.core.topology import pareto_icdf
-from tpu_gossip.kernels.permute import apply_pipeline, inverse_tables
+from tpu_gossip.kernels.permute import (
+    apply_pipeline,
+    fold_planes,
+    inverse_tables,
+)
 from tpu_gossip.kernels.pallas_segment import bernoulli_threshold_device
 
 __all__ = ["MatchingPlan", "matching_powerlaw_graph", "quantile_degrees"]
+
+# classes at or above this node count store slots position-major with
+# 1024-aligned plane strides (Pallas fold); smaller classes store
+# node-major (wide pad_deg minor) — see MatchingPlan.reduce
+_POS_MAJOR_MIN = 8192
 
 
 @jax.tree_util.register_dataclass
@@ -73,8 +82,12 @@ __all__ = ["MatchingPlan", "matching_powerlaw_graph", "quantile_degrees"]
 class MatchingPlan:
     """Static routing state for structured-matching delivery.
 
-    ``classes`` is a tuple of (node_off, slot_off, count, pad_deg) runs —
-    all Python ints, so expand/reduce slicing is static. Lane tables are
+    ``classes`` is a tuple of (node_off, slot_off, count, pad_deg,
+    cstride) runs — all Python ints, so expand/reduce slicing is static.
+    Populous classes (count >= _POS_MAJOR_MIN) are POSITION-major with
+    1024-aligned plane stride ``cstride`` and slot_off (the Pallas
+    plane-fold layout); smaller classes are NODE-major with cstride ==
+    count (their reduce minor-dim is the wide pad_deg). Lane tables are
     int8 (int32 on sub-32-row-granularity small plans); ``valid`` marks
     slots that survived erasure (a live directed edge
     owner(j) <- owner(pi(j))). Sampling gates are COMPUTED per round from
@@ -152,20 +165,42 @@ class MatchingPlan:
     def expand(self, x_n: jax.Array) -> jax.Array:
         """Broadcast per-node values (n,) onto slots (R, 128) — no gather.
 
-        Classes store slots POSITION-major: all of a class's nodes' i-th
-        stubs are contiguous, so expansion is a wide (pad_deg, count)
-        broadcast and reduction a wide reshape — never a (count, pad_deg)
-        array, whose tiny trailing dim TPU tiling pads 128-wide (measured
-        as a 64x / 13 GB HLO-temp explosion at the 10M north star).
+        Orientation is per class (see the class docstring): populous
+        classes broadcast position-major (pad_deg, cstride) planes, small
+        classes node-major (count, pad_deg) runs — in both the trailing
+        dim is the WIDE one, because any tiny-minor-dim array gets its
+        trailing dim padded 128-wide by the (8, 128) tiling (measured as a
+        64x / 13 GB HLO-temp explosion at the 10M north star). Alignment
+        gaps between classes are materialized as zero pieces so slot_off
+        is the single source of layout truth.
         """
         pieces = []
-        for node_off, _slot_off, count, pad_deg in self.classes:
-            pieces.append(
-                jnp.broadcast_to(
-                    jax.lax.dynamic_slice_in_dim(x_n, node_off, count)[None, :],
-                    (pad_deg, count),
-                ).reshape(-1)
-            )
+        cur = 0
+        for node_off, slot_off, count, pad_deg, cstride in self.classes:
+            if slot_off > cur:  # alignment gap (dead slots)
+                pieces.append(jnp.zeros((slot_off - cur,), x_n.dtype))
+            cur = slot_off + pad_deg * cstride
+            x_c = jax.lax.dynamic_slice_in_dim(x_n, node_off, count)
+            if count >= _POS_MAJOR_MIN:
+                # position-major: planes of cstride (128^2-aligned), wide
+                if cstride != count:
+                    x_c = jnp.concatenate(
+                        [x_c, jnp.zeros((cstride - count,), x_c.dtype)]
+                    )
+                pieces.append(
+                    jnp.broadcast_to(
+                        x_c[None, :], (pad_deg, cstride)
+                    ).reshape(-1)
+                )
+            else:
+                # node-major: each node's pad_deg stubs contiguous — the
+                # minor dim is pad_deg (wide for hub classes), so neither
+                # expand nor reduce ever materializes a tiny-minor layout
+                pieces.append(
+                    jnp.broadcast_to(
+                        x_c[:, None], (count, pad_deg)
+                    ).reshape(-1)
+                )
         flat = jnp.concatenate(pieces)
         pad = self.rows * 128 - flat.shape[0]
         if pad:
@@ -176,20 +211,46 @@ class MatchingPlan:
         """Fold slot values (R, 128) into per-node values (n,) — no scatter.
 
         ``op``: "or" (bitwise, delivery words) or "sum" (billing counts).
-        Position-major classes make this a (pad_deg, count) reshape + an
-        axis-0 reduction — wide in the populous (small-degree) classes
-        where the volume is, tiny in absolute terms for hub classes.
+        Position-major classes make each node's i-th stubs a CONTIGUOUS
+        count-length run, so narrow classes fold by accumulating pad_deg
+        1-D slices — no 2-D intermediate exists at all. (An axis-0 reduce
+        over the (pad_deg, count) view gets canonicalized by XLA:TPU into a
+        materialized [count, pad_deg] array whose tiny minor dim the
+        (8, 128) tiling pads 64x — profiled at 4 ms of the 6.9 ms 1M round
+        before this form.) Hub classes (pad_deg > 32) keep the 2-D reduce:
+        their absolute volume is tiny.
         """
         flat = slots.reshape(-1)
         outs = []
-        for _node_off, slot_off, count, pad_deg in self.classes:
-            block = jax.lax.dynamic_slice_in_dim(
-                flat, slot_off, count * pad_deg
-            ).reshape(pad_deg, count)
-            if op == "or":
-                outs.append(jnp.bitwise_or.reduce(block, axis=0))
+        for _node_off, slot_off, count, pad_deg, cstride in self.classes:
+            if count >= _POS_MAJOR_MIN:
+                # populous classes: the Pallas plane-fold kernel. Every
+                # HLO-level formulation of this fold (axis reduce, row
+                # indexing, slice chains, barriered slices) gets
+                # canonicalized by XLA:TPU into one interleaved
+                # [cstride, pad_deg] array whose tiny minor dim the
+                # (8, 128) tiling pads up to 64x — profiled at 4 ms of the
+                # 6.9 ms 1M round; in Pallas the planes stream as natural
+                # blocks (kernels/permute.fold_planes).
+                outs.append(
+                    fold_planes(
+                        slots, slot_off, cstride, count, pad_deg, op
+                    )
+                )
             else:
-                outs.append(jnp.sum(block, axis=0, dtype=slots.dtype))
+                # node-major small classes (count < _POS_MAJOR_MIN):
+                # reduce over the MINOR axis —
+                # reducing the major axis (or any tiny-minor reshape) gets
+                # canonicalized into a whole-buffer [X, count] layout with
+                # a 64x-padded minor dim (profiled: three such monsters at
+                # 129 ms per 32 rounds)
+                block = jax.lax.dynamic_slice_in_dim(
+                    flat, slot_off, count * pad_deg
+                ).reshape(count, pad_deg)
+                if op == "or":
+                    outs.append(jnp.bitwise_or.reduce(block, axis=1))
+                else:
+                    outs.append(jnp.sum(block, axis=1, dtype=slots.dtype))
         return jnp.concatenate(outs)
 
 
@@ -205,8 +266,14 @@ def quantile_degrees(
 
 def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
     """Greedy runs over the ascending degree sequence with pad_deg = run max
-    and max/min <= pad_ratio: static (node_off, slot_off, count, pad_deg)
-    tuples with total pad waste of a few percent."""
+    and max/min <= pad_ratio: static
+    (node_off, slot_off, count, pad_deg, cstride) tuples with total pad
+    waste of a few percent. ``cstride`` is the class's PLANE stride —
+    count rounded up to a multiple of 128 — so every position plane is a
+    128-aligned contiguous run: the reduce then folds planes with plain
+    elementwise ops over aligned 1-D views, which XLA cannot canonicalize
+    into the padded [count, pad_deg] layout that cost 4 ms of the 6.9 ms
+    1M round (see ``MatchingPlan.reduce``)."""
     n = len(deg)
     classes = []
     i = 0
@@ -217,8 +284,23 @@ def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
         j = int(np.searchsorted(deg, limit, side="right"))
         j = max(j, i + 1)
         pad_deg = max(1, int(deg[j - 1]))
-        classes.append((i, slot_off, j - i, pad_deg))
-        slot_off += (j - i) * pad_deg
+        count = j - i
+        # POPULOUS classes get 1024-aligned plane strides AND 1024-aligned
+        # slot offsets so their fold runs as whole (8, 128) blocks in the
+        # Pallas plane-fold kernel (permute.fold_planes); padding is a few
+        # slots per class. A hub class (count of a few, pad_deg in the
+        # thousands) would multiply its span ~1024/count-fold, so it stays
+        # exact (node-major) and folds through the 2-D reshape path (tiny
+        # absolute volume). Layout stays in tuple (degree) order — expand
+        # inserts the alignment gaps explicitly, so every consumer reads
+        # the ONE slot_off recorded here.
+        if count >= _POS_MAJOR_MIN:
+            cstride = -(-count // 1024) * 1024
+            slot_off = -(-slot_off // 1024) * 1024
+        else:
+            cstride = count
+        classes.append((i, slot_off, count, pad_deg, cstride))
+        slot_off += pad_deg * cstride
         i = j
     return tuple(classes)
 
@@ -272,14 +354,25 @@ def _build_plan(
     # --- per-slot plan vectors (owner, real-stub mask) -------------------
     owner = plan0.expand(jnp.arange(n, dtype=jnp.int32))
     sentinel_fill = jnp.arange(r * 128, dtype=jnp.int32).reshape(r, 128)
-    in_layout = sentinel_fill < sum(c * w for _, _, c, w in classes)
+    layout_end = classes[-1][1] + classes[-1][3] * classes[-1][4]
+    in_layout = sentinel_fill < layout_end
     owner = jnp.where(in_layout, owner, n)  # tail pad -> sentinel
     real = jnp.zeros((r * 128,), bool)
-    for node_off, slot_off, count, pad_deg in classes:
-        pos = jnp.arange(pad_deg, dtype=jnp.int32)[:, None]
-        d = jax.lax.dynamic_slice_in_dim(deg, node_off, count)[None, :]
+    for node_off, slot_off, count, pad_deg, cstride in classes:
+        d = jax.lax.dynamic_slice_in_dim(deg, node_off, count)
+        if count >= _POS_MAJOR_MIN:
+            pos = jnp.arange(pad_deg, dtype=jnp.int32)[:, None]
+            if cstride != count:
+                # stride-pad columns are dead: degree 0 fails every pos < d
+                d = jnp.concatenate(
+                    [d, jnp.zeros((cstride - count,), d.dtype)]
+                )
+            mask = (pos < d[None, :]).reshape(-1)
+        else:
+            pos = jnp.arange(pad_deg, dtype=jnp.int32)[None, :]
+            mask = (pos < d[:, None]).reshape(-1)
         real = jax.lax.dynamic_update_slice_in_dim(
-            real, (pos < d).reshape(-1), slot_off, axis=0
+            real, mask, slot_off, axis=0
         )
     real = real.reshape(r, 128)
 
@@ -375,7 +468,8 @@ def matching_powerlaw_graph(
         d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
     deg_host = quantile_degrees(n, gamma, d_min, d_max)
     classes = _plan_classes(deg_host)
-    n_slots = sum(c * w for _, _, c, w in classes)
+    last = classes[-1]
+    n_slots = last[1] + last[3] * last[4]  # layout end incl. alignment gaps
     # rows hug the real stub count: the dead tail pairs with real stubs and
     # erases them, so it must stay tiny relative to n_slots. Large plans use
     # 32-row granularity (<= 4095 dead slots, sub-0.8%) which unlocks int8
