@@ -1,12 +1,20 @@
 """Headline benchmark: power-law push/push-pull gossip to 99% coverage.
 
-Prints ONE COMPACT JSON line (last on stdout, ≲1.5 KB so a tail capture
-can't truncate it):
+Prints the COMPACT JSON headline line (≲1.5 KB so a tail capture can't
+truncate it):
     {"metric": ..., "value": N, "unit": "peers_rounds_per_sec", "vs_baseline": N,
      "configs_ms_per_round": {...}, "north_star": {...}, "dist": {...}}
-and writes the FULL result tree (per-config rounds/coverage/msgs, hardware
-ceilings, accounting notes) to ``BENCH_DETAIL.json`` next to this file —
-the committed, reviewable record.
+TWICE: once IMMEDIATELY after the 1M headline trio (so a driver timeout
+mid-10M can never lose the headline again — the r5 artifact died at rc=124
+with nothing on stdout) and once, enriched, as the final line. A tail
+parse always reads the most complete one. The FULL result tree
+(per-config rounds/coverage/msgs, hardware ceilings, accounting notes) is
+written INCREMENTALLY to ``BENCH_DETAIL.json`` next to this file — each
+completed section lands before the next begins, so the committed record
+reflects everything that ran even if the process is killed. The 10M and
+sharded-engine sections run behind an elapsed-time budget
+(``BENCH_BUDGET_S`` env, default 2700 s): once the budget is near, the
+remaining sections are recorded as skipped and the run exits rc=0.
 
 Metric per BASELINE.json: rounds-to-99%-coverage and peers·rounds/sec on a
 1M-node power-law (γ=2.5) swarm, plus the 10M-peer north-star run
@@ -49,9 +57,11 @@ are not self-referential. Per-config ``access_rate_per_sec_M`` uses the
 random-access ceiling as denominator: dissemination is bound by random
 gather/scatter access rate, not FLOPs (SURVEY.md §5.1 accounting).
 
-Flags: --quick (1M only, 1 rep, skips the sharded-engine entry) · --dist
-(force the sharded-engine run even under --quick) · --profile DIR
-(jax.profiler trace of one warmed headline run).
+Flags: --quick (1M only, 1 rep, skips the sharded-engine entry — the smoke
+invocation, see README) · --dist (force the sharded-engine run even under
+--quick) · --profile DIR (jax.profiler trace of one warmed headline run).
+Env: BENCH_BUDGET_S (elapsed-seconds budget for the post-headline
+sections; default 2700).
 """
 
 from __future__ import annotations
@@ -406,6 +416,109 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     }
 
 
+def _timed_coverage(run, n: int, reps: int):
+    """Warm + min-wall timing of a zero-arg run-to-coverage callable (the
+    scalar fetch is the completion barrier on the axon tunnel)."""
+
+    fin = run()  # warm (compile)
+    cov, rounds = float(fin.coverage(0)), int(fin.round)
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        fin = run()
+        float(fin.coverage(0))  # completion barrier
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "rounds": rounds, "coverage": round(cov, 4),
+        "wall_seconds": round(best, 3),
+        "ms_per_round": round(best / max(rounds, 1) * 1000.0, 4),
+        "peers_rounds_per_sec": round(n * rounds / max(best, 1e-9), 1),
+    }
+
+
+def bench_dist_matching(n: int, reps: int = 3):
+    """Sharded MATCHING delivery over the available mesh vs the IDENTICAL
+    plan through the local engine — the dist overhead decomposition for
+    the gather-free pipeline (the round-6 tentpole).
+
+    ``matching_powerlaw_graph_sharded`` lays the swarm out per shard; the
+    dist round runs expand/shuffle/fold shard-locally with each transpose
+    pass as one dense ``all_to_all`` (dist/matching_mesh.py), and the SAME
+    plan object runs the local engine — same RNG stream, bit-identical
+    trajectories (tests/sim/test_dist.py) — so ``overhead`` isolates pure
+    collective + shard_map cost with zero statistical noise: identical
+    rounds, identical work, the delta IS the transport. At mesh size 1
+    that is the all_to_all(1)/reshape plumbing floor.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.dist import (
+        make_mesh, run_until_coverage_dist, shard_matching_plan, shard_swarm,
+    )
+    from tpu_gossip.sim.engine import run_until_coverage
+
+    mesh = make_mesh()
+    if 128 % mesh.size:
+        # the transpose all_to_all splits the 128-lane axis — a mesh size
+        # that does not divide 128 cannot run this layout. Record the
+        # incompatibility instead of raising: the benchmark's contract is
+        # rc=0 with everything measurable recorded
+        return {
+            "n_peers": n, "devices": mesh.size,
+            "unsupported": f"mesh size {mesh.size} does not divide 128 "
+            "(matching_powerlaw_graph_sharded lane-split constraint); "
+            "the bucketed-CSR dist entry covers this mesh",
+        }
+    t0 = time.perf_counter()
+    g, plan = matching_powerlaw_graph_sharded(
+        n, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    int(jnp.sum(plan.valid))  # scalar fetch = completion barrier
+    build_s = time.perf_counter() - t0
+    plan_m = shard_matching_plan(plan, mesh)
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull")
+    # one rumor per slot at the lowest ids (shard 0's minimum-degree peers
+    # — the conservative origin choice, as in the local benchmarks)
+    st0 = init_swarm(
+        g.as_padded_graph(), cfg, origins=np.arange(cfg.msg_slots),
+        origin_slots=np.arange(cfg.msg_slots), exists=g.exists,
+        key=jax.random.key(0),
+    )
+    st = shard_swarm(st0, mesh)
+    dist = _timed_coverage(
+        lambda: run_until_coverage_dist(st, cfg, plan_m, mesh, 0.99, 300),
+        n, reps,
+    )
+    local = _timed_coverage(
+        lambda: run_until_coverage(st0, cfg, 0.99, 300, plan=plan), n, reps
+    )
+    return {
+        "n_peers": n, "devices": mesh.size, "msg_slots": cfg.msg_slots,
+        "build_seconds": round(build_s, 2),
+        "dist": dist, "local_same_plan": local,
+        "overhead": {
+            "dist_ms_per_round": dist["ms_per_round"],
+            "local_ms_per_round": local["ms_per_round"],
+            "collective_overhead_ms": round(
+                dist["ms_per_round"] - local["ms_per_round"], 4
+            ),
+            "overhead_vs_local": round(
+                dist["ms_per_round"] / max(local["ms_per_round"], 1e-9), 3
+            ),
+        },
+        "note": "identical plan + RNG stream on both engines → bit-identical"
+        " trajectories; the per-round delta is pure shard_map/collective"
+        " transport (transposes as dense all_to_all), not sampling noise",
+    }
+
+
 def bench_dist(n: int, reps: int = 3):
     """Sharded-engine run over the available device mesh (1 real TPU chip
     here; 8 virtual CPU devices under the test env) — the multi-chip path's
@@ -437,20 +550,7 @@ def bench_dist(n: int, reps: int = 3):
     st0 = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
 
     def timed(run):
-        fin = run()  # warm (compile)
-        cov, rounds = float(fin.coverage(0)), int(fin.round)
-        best = float("inf")
-        for _ in range(max(reps, 1)):
-            t0 = time.perf_counter()
-            fin = run()
-            float(fin.coverage(0))  # completion barrier
-            best = min(best, time.perf_counter() - t0)
-        return {
-            "rounds": rounds, "coverage": round(cov, 4),
-            "wall_seconds": round(best, 3),
-            "ms_per_round": round(best / max(rounds, 1) * 1000.0, 4),
-            "peers_rounds_per_sec": round(n * rounds / max(best, 1e-9), 1),
-        }
+        return _timed_coverage(run, n, reps)
 
     st = shard_swarm(st0, mesh)
     dist = timed(lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300))
@@ -489,6 +589,17 @@ def main(argv: list[str] | None = None) -> int:
     import os
 
     import jax
+
+    # elapsed-time budget for the post-headline sections (10M north star,
+    # sharded-engine entries): the driver kills long runs (r5 died at
+    # rc=124 with the headline unrecorded), so once the budget nears, the
+    # remaining sections are RECORDED AS SKIPPED and the run exits rc=0
+    # with everything measured so far committed
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "2700"))
+    t_start = time.perf_counter()
+
+    def elapsed() -> float:
+        return time.perf_counter() - t_start
 
     # persistent on-disk compilation cache: compiles survive process
     # restarts, so 'cold' setup figures reflect a warmed production cache
@@ -531,9 +642,73 @@ def main(argv: list[str] | None = None) -> int:
         "push_pull_k1_m16_xla": hl_xla,
         "push_pull_k1_m16_pallas": hl_pal,
         "push_pull_k1_m16_matching": hl_match,
-        # historical msg_slots=1 shape (cross-round comparability with r01/r02)
-        "push_pull_k1_m1_xla": bench_one(dg1, "push_pull", 1, msg_slots=1, reps=reps),
     }
+    out = {
+        "metric": "1M-node power-law (gamma=2.5) push-pull gossip to 99% coverage",
+        "value": headline["peers_rounds_per_sec"],
+        "unit": "peers_rounds_per_sec",
+        "vs_baseline": round(headline["peers_rounds_per_sec"] / REFERENCE_PEERS_ROUNDS_PER_SEC, 1),
+        "rounds_to_99pct": headline["rounds"],
+        "wall_seconds": headline["wall_seconds"],
+        "headline_delivery": headline["delivery"],
+        "setup_seconds_1m": round(setup_1m, 2),
+        "plan_build_seconds_1m": round(plan1_k1_s + plan1_k3_s + plan1_fl_s, 2),
+        "matching_build_seconds_1m": round(match1_s, 2),
+        "configs": configs,
+        "hardware_ceilings": ceilings,
+        "graph": "on-device erased configuration model (core/device_topology.py"
+        " for xla/pallas; structured-matching twin core/matching_topology.py"
+        " for matching configs)",
+        # entry count + jax version, not a bald warm/cold claim: cache keys
+        # include the jaxlib version, so entries can be present yet stale
+        "compilation_cache": {
+            "entries_at_start": cache_entries,
+            "jax": jax.__version__,
+        },
+        "budget_seconds": budget_s,
+        "sections_skipped": [],
+    }
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+    )
+
+    def flush_detail():
+        """Write the record INCREMENTALLY — each completed section lands
+        before the next begins, so a killed run still leaves a truthful
+        committed artifact. --quick smoke runs never clobber a full run's
+        record."""
+        if quick:
+            return
+        out["elapsed_seconds"] = round(elapsed(), 1)
+        with open(detail_path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def skip(section: str) -> bool:
+        """True (and records the skip) when the budget is too spent for
+        ``section`` — the guard that keeps rc=0 with the headline printed."""
+        frac = {"north_star_10m": 0.40, "dist_200k": 0.70,
+                "dist_1m": 0.78, "dist_10m": 0.88}[section]
+        if elapsed() <= budget_s * frac:
+            return False
+        out["sections_skipped"].append(
+            {"section": section, "elapsed_seconds": round(elapsed(), 1)}
+        )
+        return True
+
+    # the headline is on stdout from HERE — a driver timeout in any later
+    # section can no longer lose it (the final, enriched compact line is
+    # printed again at exit; tail-parsing reads the most complete one)
+    early = {**_compact(out), "partial": True}
+    if quick:
+        early["detail_file"] = None
+    print(json.dumps(early), flush=True)
+    flush_detail()
+
+    # historical msg_slots=1 shape (cross-round comparability with r01/r02)
+    configs["push_pull_k1_m1_xla"] = bench_one(
+        dg1, "push_pull", 1, msg_slots=1, reps=reps
+    )
     if not quick:
         # 64-slot headline shape (VERDICT r4 item 8): two word groups, the
         # multi-word path unit tests exercise, now measured at scale
@@ -622,6 +797,7 @@ def main(argv: list[str] | None = None) -> int:
         # BASELINE config 2: 1k peers + 3-miss liveness (detection latency
         # vs the reference's 30-42 s worst-case band, SURVEY.md §6)
         configs["liveness_1k"] = bench_liveness(reps=reps)
+    flush_detail()
 
     if profile_dir:
         # one warmed headline rep under the device tracer (SURVEY.md §5.1)
@@ -632,32 +808,8 @@ def main(argv: list[str] | None = None) -> int:
                 bench_one(dg1, "push_pull", 1, msg_slots=16, reps=1,
                           plan=plan1_k1 if headline is hl_pal else None)
 
-    out = {
-        "metric": "1M-node power-law (gamma=2.5) push-pull gossip to 99% coverage",
-        "value": headline["peers_rounds_per_sec"],
-        "unit": "peers_rounds_per_sec",
-        "vs_baseline": round(headline["peers_rounds_per_sec"] / REFERENCE_PEERS_ROUNDS_PER_SEC, 1),
-        "rounds_to_99pct": headline["rounds"],
-        "wall_seconds": headline["wall_seconds"],
-        "headline_delivery": headline["delivery"],
-        "setup_seconds_1m": round(setup_1m, 2),
-        "plan_build_seconds_1m": round(plan1_k1_s + plan1_k3_s + plan1_fl_s, 2),
-        "matching_build_seconds_1m": round(match1_s, 2),
-        "configs": configs,
-        "hardware_ceilings": ceilings,
-        "graph": "on-device erased configuration model (core/device_topology.py"
-        " for xla/pallas; structured-matching twin core/matching_topology.py"
-        " for matching configs)",
-        # entry count + jax version, not a bald warm/cold claim: cache keys
-        # include the jaxlib version, so entries can be present yet stale
-        "compilation_cache": {
-            "entries_at_start": cache_entries,
-            "jax": jax.__version__,
-        },
-    }
-
     # --- 10M north star ---------------------------------------------------
-    if not quick:
+    if not quick and not skip("north_star_10m"):
         t0 = time.perf_counter()
         dg10 = device_powerlaw_graph(10_000_000, gamma=2.5, key=jax.random.key(0))
         int(dg10.row_ptr[-1])
@@ -796,27 +948,37 @@ def main(argv: list[str] | None = None) -> int:
             "sir_10m": sir10,
             "churn_10m": churn10,
         }
+        flush_detail()
 
     if with_dist or not quick:
         # sharded-engine overhead is part of the default artifact (VERDICT
         # r3 item 5): mesh size 1 on the TPU chip = pure bucketing overhead
-        out["dist"] = bench_dist(200_000, reps=reps)
-        if not quick:
-            # the 1M dist entry (VERDICT r4 item 2): overhead at headline
-            # scale, on the zero-gather streaming receive
-            out["dist_1m"] = bench_dist(1_000_000, reps=reps)
+        if not skip("dist_200k"):
+            out["dist"] = bench_dist(200_000, reps=reps)
+            flush_detail()
+        if not quick and not skip("dist_1m"):
+            # the 1M dist entries (VERDICT r4 item 2 + the round-6
+            # tentpole): bucketed-CSR overhead on the zero-gather
+            # streaming receive, AND the sharded matching pipeline quoted
+            # against the identical plan's local round
+            out["dist_1m"] = {
+                **bench_dist(1_000_000, reps=reps),
+                "matching": bench_dist_matching(1_000_000, reps=reps),
+            }
+            flush_detail()
+        if not quick and not skip("dist_10m"):
+            # north-star scale on the mesh: matching only (partition_graph
+            # buckets a 10M CSR host-side — minutes of numpy — while the
+            # matching layout is mesh-native from build)
+            out["dist_10m"] = {
+                "matching": bench_dist_matching(10_000_000, reps=1),
+            }
+            flush_detail()
 
-    # Full detail goes to a committed file; stdout's LAST line is a compact
-    # headline the driver's tail capture can always parse (the r3 artifact
-    # outgrew it: BENCH_r03.json "parsed": null). --quick smoke runs must
-    # not clobber a full run's committed record.
-    if not quick:
-        detail_path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
-        )
-        with open(detail_path, "w") as f:
-            json.dump(out, f, indent=1, sort_keys=True)
-            f.write("\n")
+    # stdout's LAST line is the enriched compact headline (the early print
+    # after the 1M trio covers driver-timeout deaths; this one supersedes
+    # it when the run completes). --quick runs never write the record.
+    flush_detail()
     compact = _compact(out)
     if quick:
         compact["detail_file"] = None  # quick runs don't write the record
@@ -856,17 +1018,34 @@ def _compact(out: dict) -> dict:
                 for p in paths if p in ns["flood_10m"]
             },
         }
-    for key in ("dist", "dist_1m"):
+    for key in ("dist", "dist_1m", "dist_10m"):
         dist = out.get(key)
-        if dist:
-            compact[key] = {
+        if not dist:
+            continue
+        row = {}
+        if "dist" in dist:  # bucketed-CSR engine entry
+            row.update({
                 "devices": dist["devices"],
                 "ms_per_round": dist["dist"]["ms_per_round"],
                 "pallas_ms_per_round": dist["dist_pallas"]["ms_per_round"],
                 "local_ms_per_round": dist["local_same_graph"]["ms_per_round"],
                 "overhead_vs_local": dist["overhead_vs_local"],
                 "overhead_vs_local_pallas": dist["overhead_vs_local_pallas"],
-            }
+            })
+        m = dist.get("matching")
+        if m:  # sharded matching pipeline entry (bench_dist_matching)
+            row.setdefault("devices", m["devices"])
+            if "overhead" in m:
+                row["matching_ms_per_round"] = m["overhead"]["dist_ms_per_round"]
+                row["matching_local_ms_per_round"] = m["overhead"]["local_ms_per_round"]
+                row["matching_overhead_vs_local"] = m["overhead"]["overhead_vs_local"]
+            else:  # recorded as unsupported on this mesh size
+                row["matching_unsupported"] = True
+        compact[key] = row
+    if out.get("sections_skipped"):
+        compact["sections_skipped"] = [
+            s["section"] for s in out["sections_skipped"]
+        ]
     compact["detail_file"] = "BENCH_DETAIL.json"
     return compact
 

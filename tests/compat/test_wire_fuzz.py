@@ -14,8 +14,13 @@ import pickle
 import pickletools
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+# property-based tests need hypothesis; environments without it (the
+# container image bakes a fixed dependency set) skip cleanly instead of
+# erroring at collection
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from tpu_gossip.compat import wire
 

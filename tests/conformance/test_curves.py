@@ -203,3 +203,62 @@ def test_sim_curve_deterministic():
     a = sim_curve(graph, 0, 10, seed=7)
     b = sim_curve(graph, 0, 10, seed=7)
     np.testing.assert_array_equal(a, b)
+
+
+def test_matching_vs_device_family_curves_agree():
+    """Cross-family conformance: the structured-matching generator and the
+    sort-based device generator sample the SAME erased configuration model
+    (same truncated-Pareto law, same erasure rule), so at matched
+    (gamma, fanout, n) their coverage-vs-round curves must agree within
+    stochastic tolerance — the matching family's deterministic quantile
+    degrees and pipeline pairing must not change the epidemic. Push mode,
+    hub origin on both sides (matching ids are degree-ascending, so its
+    hub is the last real id; the device family's is argmax degree)."""
+    import jax
+
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.sim.engine import simulate
+    from tpu_gossip.sim.metrics import rounds_to_coverage
+
+    n, gamma, fanout, rounds = 20_000, 2.5, 3, 40
+    seeds = range(3)
+
+    def device_curves():
+        out = []
+        for s in seeds:
+            dg = device_powerlaw_graph(n, gamma=gamma, key=jax.random.key(s))
+            cfg = SwarmConfig(n_peers=dg.n_pad, msg_slots=4, fanout=fanout,
+                              mode="push")
+            origin = int(np.argmax(np.asarray(dg.degrees)[:n]))
+            st = init_swarm(dg.as_padded_graph(), cfg, origins=[origin],
+                            exists=dg.exists, key=jax.random.key(100 + s))
+            _, stats = simulate(st, cfg, rounds)
+            out.append(stats)
+        return out
+
+    def matching_curves():
+        out = []
+        for s in seeds:
+            mg, plan = matching_powerlaw_graph(
+                n, gamma=gamma, fanout=fanout, key=jax.random.key(s)
+            )
+            cfg = SwarmConfig(n_peers=plan.n + 1, msg_slots=4, fanout=fanout,
+                              mode="push")
+            st = init_swarm(mg.as_padded_graph(), cfg, origins=[n - 1],
+                            exists=mg.exists, key=jax.random.key(100 + s))
+            _, stats = simulate(st, cfg, rounds, plan)
+            out.append(stats)
+        return out
+
+    dev, mat = device_curves(), matching_curves()
+    for target, tol in ((0.5, 3), (0.99, 4)):
+        r_dev = np.median([rounds_to_coverage(s, target) for s in dev])
+        r_mat = np.median([rounds_to_coverage(s, target) for s in mat])
+        assert r_dev > 0 and r_mat > 0, (target, r_dev, r_mat)
+        assert abs(r_dev - r_mat) <= tol, (target, r_dev, r_mat)
+    # same epidemic shape mid-curve (both families, mean over seeds)
+    c_dev = np.mean([np.asarray(s.coverage) for s in dev], axis=0)
+    c_mat = np.mean([np.asarray(s.coverage) for s in mat], axis=0)
+    assert np.max(np.abs(c_dev[5:25] - c_mat[5:25])) <= 0.35

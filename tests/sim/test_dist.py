@@ -310,6 +310,159 @@ def test_dist_checkpoint_resume_local(tmp_path):
     assert float(fin_l.coverage(0)) > 0
 
 
+# --- sharded matching delivery (the gather-free pipeline multi-chip) -----
+
+
+@pytest.fixture(scope="module")
+def matching_setup():
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.dist import shard_matching_plan
+
+    g, plan = matching_powerlaw_graph_sharded(
+        1500, 8, fanout=2, key=jax.random.key(0)
+    )
+    mesh = make_mesh(8)
+    return g, plan, shard_matching_plan(plan, mesh), mesh
+
+
+def _matching_state(g, cfg, seed=3, origins=(0, 5)):
+    from tpu_gossip.core.state import init_swarm
+
+    return init_swarm(
+        g.as_padded_graph(), cfg, origins=list(origins), exists=g.exists,
+        key=jax.random.key(seed),
+    )
+
+
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("flood", {}),
+        ("push", {}),
+        ("push_pull", {}),
+        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                           rewire_slots=2)),
+        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                           rewire_slots=2, rewire_compact_cap=64)),
+        ("push_pull", dict(sir_recover_rounds=2)),
+        # forward_once is the only config taking the answer-bitmap branch
+        # (a second expand+pipeline pass per word group inside shard_map)
+        ("push_pull", dict(forward_once=True)),
+    ],
+    ids=["flood", "push", "push_pull", "push_pull_churn",
+         "push_pull_churn_compact", "push_pull_sir", "push_pull_fwd_once"],
+)
+def test_matching_dist_bit_identical_to_single_chip(matching_setup, mode, extra):
+    """The shard-vs-single-chip equivalence is BIT-exact, full trajectory:
+    the mesh round splits keys exactly like gossip_round, draws sampling
+    bits at the global shape (threefry is position-deterministic), and the
+    all_to_all transposes compute the identical global bijection — so the
+    same plan + state must yield identical seen/msgs/liveness/churn on
+    both engines, every mode, re-wiring included. (The bucketed CSR engine
+    can only match the local engine in distribution; the matching pipeline
+    matches it bit for bit.)"""
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode=mode, **extra)
+    st = _matching_state(g, cfg)
+    fin_l, stats_l = simulate(st, cfg, 5, plan)
+    fin_d, stats_d = simulate_dist(shard_swarm(st, mesh), cfg, plan_m, mesh, 5)
+    np.testing.assert_array_equal(np.asarray(fin_l.seen), np.asarray(fin_d.seen))
+    np.testing.assert_array_equal(
+        np.asarray(stats_l.msgs_sent), np.asarray(stats_d.msgs_sent)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(stats_l.coverage), np.asarray(stats_d.coverage)
+    )
+    for f in ("alive", "rewired", "declared_dead", "recovered", "last_hb",
+              "rewire_targets"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fin_l, f)), np.asarray(getattr(fin_d, f)),
+            err_msg=f,
+        )
+
+
+def test_matching_dist_reaches_coverage(matching_setup):
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode="push_pull")
+    st = shard_swarm(_matching_state(g, cfg), mesh)
+    fin = run_until_coverage_dist(st, cfg, plan_m, mesh, 0.95, 200)
+    assert float(fin.coverage(0)) >= 0.95
+    assert int(fin.round) < 60
+
+
+def test_matching_dist_multiword(matching_setup):
+    """m > 32: one pipeline application per 32-slot word group per shard,
+    same edge activation across groups — still bit-exact vs local."""
+    import dataclasses
+
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=48, fanout=2, mode="push_pull")
+    st = _matching_state(g, cfg, seed=5, origins=(0,))
+    # one distinct rumor per slot so BOTH word groups carry live traffic
+    rows = (np.arange(48) // plan.n_per) * plan.n_blk + (np.arange(48) % plan.n_per)
+    st = dataclasses.replace(
+        st, seen=st.seen.at[rows, np.arange(48)].set(True)
+    )
+    fin_l, _ = simulate(st, cfg, 3, plan)
+    fin_d, _ = simulate_dist(shard_swarm(st, mesh), cfg, plan_m, mesh, 3)
+    seen_l = np.asarray(fin_l.seen)
+    assert seen_l[:, 32:].any(), "second word group never carried traffic"
+    np.testing.assert_array_equal(seen_l, np.asarray(fin_d.seen))
+
+
+def test_matching_dist_sharding_layout(matching_setup):
+    """Peer-axis state leaves stay peer-sharded through matching rounds —
+    the pipeline's collectives must not leave anything replicated."""
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(
+        n_peers=plan.n, msg_slots=4, fanout=2, mode="push_pull",
+        churn_leave_prob=0.01, churn_join_prob=0.1, rewire_slots=2,
+    )
+    st = shard_swarm(_matching_state(g, cfg), mesh)
+    fin, _ = simulate_dist(st, cfg, plan_m, mesh, 3)
+    bad = {}
+    for f in type(fin).__dataclass_fields__:
+        v = getattr(fin, f)
+        if hasattr(v, "ndim") and v.ndim >= 1 and v.shape[0] == plan.n:
+            spec = str(v.sharding.spec)
+            if "peers" not in spec:
+                bad[f] = spec
+    assert not bad, f"state leaves lost the peer sharding: {bad}"
+
+
+def test_matching_dist_pad_rows_stay_dead(matching_setup):
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(
+        n_peers=plan.n, msg_slots=4, fanout=2, mode="push_pull",
+        churn_join_prob=0.5,
+    )
+    st = shard_swarm(_matching_state(g, cfg), mesh)
+    fin, _ = simulate_dist(st, cfg, plan_m, mesh, 10)
+    exists = np.asarray(g.exists)
+    assert not np.asarray(fin.alive)[~exists].any()
+    assert not np.asarray(fin.seen)[~exists].any()
+
+
+def test_matching_dist_rejects_mismatched_mesh(matching_setup):
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    g, plan, plan_m, mesh = matching_setup
+    _, plan4 = matching_powerlaw_graph_sharded(
+        600, 4, fanout=2, key=jax.random.key(0)
+    )
+    cfg = SwarmConfig(n_peers=plan4.n, msg_slots=4, fanout=2, mode="push")
+    st = _matching_state(g, SwarmConfig(n_peers=plan.n, msg_slots=4,
+                                        fanout=2, mode="push"))
+    from tpu_gossip.dist import gossip_round_dist
+
+    with pytest.raises(ValueError, match="shards"):
+        gossip_round_dist(st, cfg, plan4, mesh)
+
+
 @pytest.mark.parametrize(
     "mode,extra,kernel",
     [

@@ -329,3 +329,51 @@ def test_resume_equivalence_pallas_path(tmp_path):
     straight, _ = simulate(st0, cfg, 6, plan)
     assert bool((resumed.seen == straight.seen).all())
     assert int(resumed.round) == int(straight.round) == 6
+
+
+def test_edgeless_graph_is_not_mistaken_for_csr_free():
+    """The CSR-free sentinel is the exact (1,) col_idx shape that
+    matching_powerlaw_graph(export_csr=False) emits. A genuinely EDGELESS
+    graph carries col_idx of shape (0,) — the old ``<= 1`` heuristic
+    rejected it with a misleading export_csr=False message. It must run:
+    delivery finds no neighbors, churn re-wiring finds no endpoints, and
+    nobody beyond the origin is ever infected."""
+    from tpu_gossip.sim.engine import _require_csr, validate_rewire_width
+
+    n = 12
+    g = build_csr(n, np.zeros((0, 2), dtype=np.int64))
+    assert g.col_idx.shape[0] == 0
+    cfg = SwarmConfig(
+        n_peers=n, msg_slots=4, fanout=2, mode="push",
+        churn_leave_prob=0.05, churn_join_prob=0.3, rewire_slots=2,
+    )
+    st = init_swarm(g, cfg, origins=[0])
+    _require_csr(st, "test")  # must not raise
+    validate_rewire_width(st, cfg)  # must not raise
+    fin, stats = simulate(st, cfg, 5)
+    assert int(fin.round) == 5
+    assert int(np.asarray(fin.seen).any(-1).sum()) <= 1  # nothing spreads
+    assert not np.asarray(fin.rewired).any()  # no endpoints to attach to
+
+
+def test_csr_free_matching_graph_still_fails_loudly():
+    """The real CSR-free case keeps its loud error after the sentinel-shape
+    fix (regression guard for the heuristic change)."""
+    from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+    from tpu_gossip.sim.engine import validate_rewire_width
+
+    mg, plan = matching_powerlaw_graph(
+        600, fanout=2, key=jax.random.key(0), export_csr=False
+    )
+    assert mg.col_idx.shape[0] == 1  # the sentinel shape, exactly
+    cfg = SwarmConfig(
+        n_peers=plan.n + 1, msg_slots=4, fanout=2, mode="push",
+        churn_join_prob=0.1, rewire_slots=2,
+    )
+    st = init_swarm(mg.as_padded_graph(), cfg, origins=[0], exists=mg.exists)
+    with pytest.raises(ValueError, match="export_csr"):
+        validate_rewire_width(st, cfg)
+    cfg2 = SwarmConfig(n_peers=plan.n + 1, msg_slots=4, fanout=2, mode="push")
+    st2 = init_swarm(mg.as_padded_graph(), cfg2, origins=[0], exists=mg.exists)
+    with pytest.raises(ValueError, match="export_csr"):
+        gossip_round(st2, cfg2)  # XLA delivery without a plan reads the CSR

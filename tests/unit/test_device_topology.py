@@ -65,7 +65,12 @@ def test_parity_with_host_model():
     )
 
 
-@pytest.mark.parametrize("slack", [0.90, 0.98])
+# the mild-clip point needs enough margin that the sampled demand reliably
+# exceeds the shrunken cap: at 0.98 the post-erasure total landed ~0.3%
+# UNDER the cap on some RNG/library streams (observed on jax 0.4.x) and the
+# test's own precondition flaked; 0.96 keeps the "barely clipped" regime
+# with robust firing on every stream
+@pytest.mark.parametrize("slack", [0.90, 0.96])
 def test_clip_tail_keeps_law_and_structure(slack):
     """Force the stub budget below the sampled demand so the silent clip
     path (core/device_topology.py _build: deg_eff = clip(total-start, 0,

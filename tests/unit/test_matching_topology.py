@@ -369,3 +369,71 @@ def test_build_without_csr_export_runs_dissemination():
     )
     fin, _ = simulate(state, cfg, 14, plan)
     assert float(fin.coverage(0)) > 0.5
+
+
+def test_fold_planes_matches_numpy():
+    """Direct contract of the single-operand plane fold (the second grid
+    dimension accumulates planes over one input — operand count no longer
+    scales with pad_deg)."""
+    from tpu_gossip.kernels.permute import fold_planes
+
+    rng = np.random.default_rng(11)
+    cstride, pad_deg, count, slot_off = 2048, 5, 1900, 1024
+    total = slot_off + pad_deg * cstride
+    rows = -(-total // 1024) * 8
+    flat = rng.integers(0, 2**31, (rows * 128,), dtype=np.int32)
+    slots = jnp.asarray(flat.reshape(rows, 128))
+    view = flat[slot_off : slot_off + pad_deg * cstride].reshape(
+        pad_deg, cstride
+    )[:, :count]
+    got_or = fold_planes(slots, slot_off, cstride, count, pad_deg, "or")
+    got_sum = fold_planes(slots, slot_off, cstride, count, pad_deg, "sum")
+    np.testing.assert_array_equal(
+        np.asarray(got_or), np.bitwise_or.reduce(view, axis=0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_sum), view.sum(axis=0, dtype=np.int32)
+    )
+
+
+def test_sharded_builder_structure():
+    """matching_powerlaw_graph_sharded: identical per-shard blocks, pad
+    rows dead, CSR consistent with the plan's valid set, and the pairing a
+    fixed-point-free involution over the GLOBAL slot array (cross-shard
+    reach included)."""
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    g, p = matching_powerlaw_graph_sharded(1200, 8, fanout=2,
+                                           key=jax.random.key(4))
+    s = p.mesh_shards
+    assert s == 8 and p.rows == s * p.per_rows and p.n == s * p.n_blk
+    assert p.n_blk == p.n_per + 1
+    # global classes are the one local table shifted per shard
+    per_cls = len(p.local_classes)
+    for sh in range(s):
+        for i, (no, so, c, pd, cs) in enumerate(p.local_classes):
+            g_no, g_so, g_c, g_pd, g_cs = p.classes[sh * per_cls + i]
+            assert (g_no, g_so) == (sh * p.n_blk + no, sh * p.per_rows * 128 + so)
+            assert (g_c, g_pd, g_cs) == (c, pd, cs)
+    # involution, no fixed points
+    iota = jnp.arange(p.rows * 128, dtype=jnp.int32).reshape(p.rows, 128)
+    part = p.partner(iota)
+    np.testing.assert_array_equal(np.asarray(p.partner(part)), np.asarray(iota))
+    assert not bool(jnp.any(part == iota))
+    # pairing reaches across shard boundaries (the matching must not be
+    # banded per shard — cross-shard edges are the whole point)
+    shard_of = np.asarray(part) // (p.per_rows * 128)
+    own = np.arange(p.rows * 128).reshape(p.rows, 128) // (p.per_rows * 128)
+    assert (shard_of != own).mean() > 0.5
+    # exists pattern + degree consistency
+    exists = np.asarray(g.exists)
+    assert exists.sum() == s * p.n_per
+    assert not exists[np.arange(s) * p.n_blk + p.n_per].any()
+    deg_csr = np.diff(np.asarray(g.row_ptr))
+    dr = np.asarray(p.deg_real)
+    np.testing.assert_array_equal(dr[exists], deg_csr[: p.n][exists])
+    assert (dr[~exists] == 0).all()
+    # valid slots == directed edges (sentinel row absorbs erased slots)
+    assert int(jnp.sum(p.valid)) == int(g.row_ptr[-1] - deg_csr[-1])

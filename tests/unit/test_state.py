@@ -56,6 +56,44 @@ def test_message_slot_stable_and_in_range():
     assert len(slots) > 32  # spreads over slots
 
 
+def test_int_message_ids_mask_to_64_bits():
+    """Ids are masked to 64 bits before hashing (docs/dedup_semantics.md):
+    wide ids (uuid.int, 128-bit digests) hash their low 64 bits instead of
+    raising OverflowError, and — because two's complement makes the masked
+    bytes identical to the historical signed encoding — every in-range id
+    keeps its exact slot mapping, k=1 and k>1 alike."""
+    from tpu_gossip.core.state import message_slots
+
+    # in-range ids: masked-unsigned bytes == the old signed encoding
+    for mid in (0, 1, -1, 2**62, -(2**63), 2**63 - 1):
+        want_bytes = mid.to_bytes(8, "little", signed=True)
+        got_bytes = (mid & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+        assert want_bytes == got_bytes, mid
+    # wide ids no longer raise and equal their low-64-bit truncation
+    wide = 0xDEADBEEF_CAFEBABE_01234567_89ABCDEF
+    assert message_slots(wide, 64, 3) == message_slots(
+        wide & 0xFFFFFFFFFFFFFFFF, 64, 3
+    )
+    assert message_slots(-(2**100) - 7, 64, 2) == message_slots(
+        (-(2**100) - 7) & 0xFFFFFFFFFFFFFFFF, 64, 2
+    )
+    # the historical mapping must never drift — sim/socket conformance and
+    # existing checkpoints depend on it; re-derive it with the PRE-MASK
+    # encoding (signed to_bytes) and demand equality
+    def old_slots(mid, m, k):
+        data = mid.to_bytes(8, "little", signed=True)
+        out = []
+        for plane in range(k):
+            h = (2166136261 ^ (plane * 0x9E3779B9)) & 0xFFFFFFFF
+            for b in data:
+                h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+            out.append(h % m)
+        return tuple(out)
+
+    for mid in (424242, -5, 0, 2**63 - 1, -(2**63)):
+        assert message_slots(mid, 64, 3) == old_slots(mid, 64, 3), mid
+
+
 def test_checkpoint_roundtrip(tmp_path):
     """SURVEY.md §5.4: checkpoint/resume is pytree serialization."""
     from tpu_gossip.core.state import load_swarm, save_swarm

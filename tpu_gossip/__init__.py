@@ -33,6 +33,7 @@ from tpu_gossip.core.state import SwarmState, SwarmConfig, init_swarm
 from tpu_gossip.core.matching_topology import (
     MatchingPlan,
     matching_powerlaw_graph,
+    matching_powerlaw_graph_sharded,
 )
 
 __version__ = "0.1.0"
@@ -49,4 +50,5 @@ __all__ = [
     "init_swarm",
     "MatchingPlan",
     "matching_powerlaw_graph",
+    "matching_powerlaw_graph_sharded",
 ]

@@ -29,8 +29,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="pa: preferential attachment (Barabási–Albert); "
         "chung-lu: configuration model with P(d)~d^-gamma; "
         "matching: structured-matching erased configuration model "
-        "(device-built, gather-free delivery — the fastest path; "
-        "local engine only)",
+        "(device-built, gather-free delivery — the fastest path; with "
+        "--shard the pipeline runs per shard with transposes as "
+        "all_to_all collectives, bit-identical to the local round)",
     )
     p.add_argument("--gamma", type=float, default=2.5, help="power-law exponent (chung-lu)")
     p.add_argument("--m", type=int, default=3, help="edges per new node (pa)")
@@ -110,9 +111,13 @@ def main(argv: list[str] | None = None) -> int:
     rng = np.random.default_rng(args.seed)
     mplan = exists = None
     if args.graph == "matching":
-        if args.shard or args.remat_every > 0:
-            print("--graph matching is local-engine only (its pairing IS the "
-                  "delivery plan; no CSR re-materialization applies)",
+        if args.shard:
+            return _main_shard_matching(args, rng)
+        if args.remat_every > 0:
+            print("--graph matching cannot re-materialize locally (its "
+                  "pairing IS the delivery plan — a folded CSR has no "
+                  "pipeline); use --shard, whose remat path falls back to "
+                  "the bucketed-CSR engine on the exported CSR",
                   file=sys.stderr)
             return 2
         from tpu_gossip.core.matching_topology import matching_powerlaw_graph
@@ -402,6 +407,121 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
         **extra,
     }
     return summary, state
+
+
+def _main_shard_matching(args, rng) -> int:
+    """--shard --graph matching: the gather-free pipeline on the mesh.
+
+    The swarm is laid out per shard at build time
+    (core.matching_topology.matching_powerlaw_graph_sharded) and the round
+    runs expand/shuffle/fold shard-locally with each transpose pass as one
+    dense ``all_to_all`` (dist/matching_mesh.py) — bit-identical to the
+    local matching round. ``--remat-every`` falls back to the bucketed-CSR
+    engine over the exported CSR (``partition_graph``): a re-materialized
+    CSR has no pairing pipeline, and the bucket engine owns the epoch
+    re-partition lifecycle.
+    """
+    import jax
+
+    from tpu_gossip.core.state import SwarmConfig, init_swarm, save_swarm
+    from tpu_gossip.dist import (
+        make_mesh,
+        run_until_coverage_dist,
+        shard_matching_plan,
+        shard_swarm,
+        simulate_dist,
+    )
+    from tpu_gossip.sim import metrics as M
+    from tpu_gossip.utils.profiling import trace
+
+    def fallback_to_csr_shard(reason):
+        """The ONE bucketed-CSR fallback: classic matching build, exported
+        CSR, delegate to the general shard engine."""
+        from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+
+        print(f"note: {reason} — falling back to the bucketed-CSR shard "
+              "engine on the exported CSR", file=sys.stderr)
+        dgraph, _ = matching_powerlaw_graph(
+            args.peers, gamma=args.gamma, fanout=None,
+            key=jax.random.key(args.seed),
+        )
+        return _main_shard(args, dgraph.to_host_graph(), rng)
+
+    if args.remat_every > 0:
+        return fallback_to_csr_shard(
+            "--remat-every re-materializes the CSR, which the matching "
+            "pipeline cannot absorb"
+        )
+    if args.staircase:
+        print("note: --staircase is ignored with --graph matching (the "
+              "matching pipeline IS the delivery plan)", file=sys.stderr)
+
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    mesh = make_mesh()
+    if 128 % mesh.size:
+        # the transpose all_to_all splits the 128-lane axis; a mesh size
+        # that does not divide 128 cannot run the sharded matching layout
+        return fallback_to_csr_shard(
+            f"mesh size {mesh.size} does not divide 128 (the sharded "
+            "matching transpose's lane split)"
+        )
+    dgraph, plan = matching_powerlaw_graph_sharded(
+        args.peers, mesh.size, gamma=args.gamma,
+        fanout=None if args.mode == "flood" else args.fanout,
+        key=jax.random.key(args.seed),
+    )
+    plan = shard_matching_plan(plan, mesh)
+    cfg = SwarmConfig(
+        n_peers=plan.n,  # per-shard blocks incl. born-dead pad rows
+        msg_slots=args.slots,
+        fanout=args.fanout,
+        mode=args.mode,
+        forward_once=args.forward_once,
+        sir_recover_rounds=args.sir_recover,
+        churn_leave_prob=args.churn_leave,
+        churn_join_prob=args.churn_join,
+        rewire_slots=args.rewire_slots,
+        rewire_compact_cap=args.rewire_compact_cap,
+    )
+    origins, silent_ids = _sample_ids(args, rng)
+
+    def to_rows(ids):
+        """Peer index -> state row (skipping each shard's pad row)."""
+        ids = np.asarray(ids)
+        return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+    state = init_swarm(
+        dgraph.as_padded_graph(), cfg, key=jax.random.key(args.seed),
+        origins=to_rows(origins), exists=dgraph.exists,
+    )
+    if silent_ids is not None:
+        state.silent = state.silent.at[to_rows(silent_ids)].set(True)
+    state = shard_swarm(state, mesh)
+
+    with trace(args.profile):
+        if args.rounds > 0:
+            fin, stats = simulate_dist(state, cfg, plan, mesh, args.rounds)
+            if not args.quiet:
+                M.write_jsonl(stats, sys.stdout)
+            summary = _horizon_summary(args, stats, devices=mesh.size)
+        else:
+            result, fin = M.bench_swarm(
+                state, cfg, args.target, args.max_rounds, n_peers=args.peers,
+                run=lambda: run_until_coverage_dist(
+                    state, cfg, plan, mesh, args.target, args.max_rounds
+                ),
+            )
+            summary = {"summary": True, "mode": args.mode,
+                       "devices": mesh.size, "delivery": "matching",
+                       **json.loads(result.to_json())}
+    print(json.dumps(summary))
+
+    if args.checkpoint:
+        save_swarm(args.checkpoint, fin)
+    return 0
 
 
 def _main_shard(args, graph, rng) -> int:

@@ -69,7 +69,15 @@ from tpu_gossip.kernels.permute import (
 )
 from tpu_gossip.kernels.pallas_segment import bernoulli_threshold_device
 
-__all__ = ["MatchingPlan", "matching_powerlaw_graph", "quantile_degrees"]
+__all__ = [
+    "MatchingPlan",
+    "matching_powerlaw_graph",
+    "matching_powerlaw_graph_sharded",
+    "quantile_degrees",
+    "pipeline_stages",
+    "expand_classes",
+    "reduce_classes",
+]
 
 # classes at or above this node count store slots position-major with
 # 1024-aligned plane strides (Pallas fold); smaller classes store
@@ -107,6 +115,18 @@ class MatchingPlan:
     rows: int = dataclasses.field(default=0, metadata=dict(static=True))
     classes: tuple = dataclasses.field(default=(), metadata=dict(static=True))
     fanout: int | None = dataclasses.field(default=None, metadata=dict(static=True))
+    # mesh metadata (matching_powerlaw_graph_sharded): the global layout is
+    # ``mesh_shards`` identical per-shard blocks — shard s owns state rows
+    # [s*n_blk, (s+1)*n_blk) (n_per real + 1 pad) and slot rows
+    # [s*per_rows, (s+1)*per_rows), each laid out by ``local_classes``
+    # (node/slot offsets relative to the shard's block). mesh_shards == 1
+    # for the classic single-layout build; the dist engine
+    # (dist/matching_mesh.py) requires mesh_shards == mesh.size.
+    mesh_shards: int = dataclasses.field(default=1, metadata=dict(static=True))
+    n_per: int = dataclasses.field(default=0, metadata=dict(static=True))
+    n_blk: int = dataclasses.field(default=0, metadata=dict(static=True))
+    per_rows: int = dataclasses.field(default=0, metadata=dict(static=True))
+    local_classes: tuple = dataclasses.field(default=(), metadata=dict(static=True))
 
     def with_fanout(self, fanout: int):
         """Rebind the sampling fanout — free: thresholds are computed
@@ -150,13 +170,7 @@ class MatchingPlan:
         only within ~128^K rows — which at the 10M scale (R=435k, K=2)
         measured as 64 rounds to 99% coverage instead of ~16.
         """
-        fwd = []
-        for ln in self.lanes:
-            fwd += [("lane", ln), ("t",)]
-        bwd = []
-        for ln in reversed(self.lanes_inv):
-            bwd += [("tinv",), ("lane", ln)]
-        return tuple(fwd) + (("lane", self.m3),) + tuple(bwd)
+        return pipeline_stages(self.lanes, self.m3, self.lanes_inv)
 
     def partner(self, x: jax.Array, *, interpret: bool | None = None) -> jax.Array:
         """out[j] = x[pi(j)] over (R, 128) slot data — ONE pipeline pass."""
@@ -164,94 +178,119 @@ class MatchingPlan:
 
     def expand(self, x_n: jax.Array) -> jax.Array:
         """Broadcast per-node values (n,) onto slots (R, 128) — no gather.
-
-        Orientation is per class (see the class docstring): populous
-        classes broadcast position-major (pad_deg, cstride) planes, small
-        classes node-major (count, pad_deg) runs — in both the trailing
-        dim is the WIDE one, because any tiny-minor-dim array gets its
-        trailing dim padded 128-wide by the (8, 128) tiling (measured as a
-        64x / 13 GB HLO-temp explosion at the 10M north star). Alignment
-        gaps between classes are materialized as zero pieces so slot_off
-        is the single source of layout truth.
-        """
-        pieces = []
-        cur = 0
-        for node_off, slot_off, count, pad_deg, cstride in self.classes:
-            if slot_off > cur:  # alignment gap (dead slots)
-                pieces.append(jnp.zeros((slot_off - cur,), x_n.dtype))
-            cur = slot_off + pad_deg * cstride
-            x_c = jax.lax.dynamic_slice_in_dim(x_n, node_off, count)
-            if count >= _POS_MAJOR_MIN:
-                # position-major: planes of cstride (128^2-aligned), wide
-                if cstride != count:
-                    x_c = jnp.concatenate(
-                        [x_c, jnp.zeros((cstride - count,), x_c.dtype)]
-                    )
-                pieces.append(
-                    jnp.broadcast_to(
-                        x_c[None, :], (pad_deg, cstride)
-                    ).reshape(-1)
-                )
-            else:
-                # node-major: each node's pad_deg stubs contiguous — the
-                # minor dim is pad_deg (wide for hub classes), so neither
-                # expand nor reduce ever materializes a tiny-minor layout
-                pieces.append(
-                    jnp.broadcast_to(
-                        x_c[:, None], (count, pad_deg)
-                    ).reshape(-1)
-                )
-        flat = jnp.concatenate(pieces)
-        pad = self.rows * 128 - flat.shape[0]
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-        return flat.reshape(self.rows, 128)
+        See :func:`expand_classes` (shared with the per-shard dist path)."""
+        return expand_classes(x_n, self.classes, self.rows)
 
     def reduce(self, slots: jax.Array, op: str = "or") -> jax.Array:
         """Fold slot values (R, 128) into per-node values (n,) — no scatter.
+        See :func:`reduce_classes` (shared with the per-shard dist path)."""
+        return reduce_classes(slots, self.classes, self.n, op)
 
-        ``op``: "or" (bitwise, delivery words) or "sum" (billing counts).
-        Position-major classes make each node's i-th stubs a CONTIGUOUS
-        count-length run, so narrow classes fold by accumulating pad_deg
-        1-D slices — no 2-D intermediate exists at all. (An axis-0 reduce
-        over the (pad_deg, count) view gets canonicalized by XLA:TPU into a
-        materialized [count, pad_deg] array whose tiny minor dim the
-        (8, 128) tiling pads 64x — profiled at 4 ms of the 6.9 ms 1M round
-        before this form.) Hub classes (pad_deg > 32) keep the 2-D reduce:
-        their absolute volume is tiny.
-        """
-        flat = slots.reshape(-1)
-        outs = []
-        for _node_off, slot_off, count, pad_deg, cstride in self.classes:
-            if count >= _POS_MAJOR_MIN:
-                # populous classes: the Pallas plane-fold kernel. Every
-                # HLO-level formulation of this fold (axis reduce, row
-                # indexing, slice chains, barriered slices) gets
-                # canonicalized by XLA:TPU into one interleaved
-                # [cstride, pad_deg] array whose tiny minor dim the
-                # (8, 128) tiling pads up to 64x — profiled at 4 ms of the
-                # 6.9 ms 1M round; in Pallas the planes stream as natural
-                # blocks (kernels/permute.fold_planes).
-                outs.append(
-                    fold_planes(
-                        slots, slot_off, cstride, count, pad_deg, op
-                    )
+
+def pipeline_stages(lanes: tuple, m3, lanes_inv: tuple) -> tuple:
+    """sigma . M3 . sigma^-1 as a stage tuple for permute.apply_pipeline.
+
+    THE pairing composition — module-level because the dist engine
+    (dist/matching_mesh.py) rebuilds it from shard-LOCAL table blocks
+    inside ``shard_map``: the composition order is what the
+    mesh-vs-single-chip bit-identity guarantee rests on, so it exists
+    exactly once (any edit here reaches both engines).
+    """
+    fwd = []
+    for ln in lanes:
+        fwd += [("lane", ln), ("t",)]
+    bwd = []
+    for ln in reversed(lanes_inv):
+        bwd += [("tinv",), ("lane", ln)]
+    return tuple(fwd) + (("lane", m3),) + tuple(bwd)
+
+
+def expand_classes(x_n: jax.Array, classes: tuple, rows: int) -> jax.Array:
+    """Broadcast per-node values onto slots (rows, 128) — no gather.
+
+    Orientation is per class (see the MatchingPlan docstring): populous
+    classes broadcast position-major (pad_deg, cstride) planes, small
+    classes node-major (count, pad_deg) runs — in both the trailing dim is
+    the WIDE one, because any tiny-minor-dim array gets its trailing dim
+    padded 128-wide by the (8, 128) tiling (measured as a 64x / 13 GB
+    HLO-temp explosion at the 10M north star). Alignment gaps between
+    classes are materialized as zero pieces so slot_off is the single
+    source of layout truth. Node gaps (the sharded layout's per-block pad
+    rows) are simply never read — node_off slicing skips them.
+
+    Module-level (not a method) because the dist engine applies it per
+    shard inside ``shard_map`` with the plan's ``local_classes`` and
+    ``per_rows`` — the SAME function computes the local block layout and
+    the global one.
+    """
+    pieces = []
+    cur = 0
+    for node_off, slot_off, count, pad_deg, cstride in classes:
+        if slot_off > cur:  # alignment gap (dead slots)
+            pieces.append(jnp.zeros((slot_off - cur,), x_n.dtype))
+        cur = slot_off + pad_deg * cstride
+        x_c = jax.lax.dynamic_slice_in_dim(x_n, node_off, count)
+        if count >= _POS_MAJOR_MIN:
+            # position-major: planes of cstride (128^2-aligned), wide
+            if cstride != count:
+                x_c = jnp.concatenate(
+                    [x_c, jnp.zeros((cstride - count,), x_c.dtype)]
                 )
+            pieces.append(
+                jnp.broadcast_to(x_c[None, :], (pad_deg, cstride)).reshape(-1)
+            )
+        else:
+            # node-major: each node's pad_deg stubs contiguous — the
+            # minor dim is pad_deg (wide for hub classes), so neither
+            # expand nor reduce ever materializes a tiny-minor layout
+            pieces.append(
+                jnp.broadcast_to(x_c[:, None], (count, pad_deg)).reshape(-1)
+            )
+    flat = jnp.concatenate(pieces)
+    pad = rows * 128 - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(rows, 128)
+
+
+def reduce_classes(
+    slots: jax.Array, classes: tuple, n_out: int, op: str = "or"
+) -> jax.Array:
+    """Fold slot values (rows, 128) into per-node values (n_out,).
+
+    ``op``: "or" (bitwise, delivery words) or "sum" (billing counts).
+    Position-major classes make each node's i-th stubs a CONTIGUOUS
+    count-length run, folded by the Pallas plane-fold kernel
+    (kernels/permute.fold_planes) — every HLO-level formulation of that
+    fold gets canonicalized by XLA:TPU into one interleaved
+    [cstride, pad_deg] array whose tiny minor dim the (8, 128) tiling pads
+    up to 64x (profiled at 4 ms of the 6.9 ms 1M round). Node-major small
+    classes reduce over the MINOR axis (reducing the major axis hits the
+    same canonicalization). Node gaps between classes — and the tail up to
+    ``n_out`` — emit zeros, so the sharded layout's per-block pad rows
+    receive nothing and ``node_off`` stays the one source of node-space
+    truth. Shared by the global plan methods and the per-shard dist path.
+    """
+    flat = slots.reshape(-1)
+    outs = []
+    cur_node = 0
+    for node_off, slot_off, count, pad_deg, cstride in classes:
+        if node_off > cur_node:  # node gap (pad rows): no slots, no result
+            outs.append(jnp.zeros((node_off - cur_node,), slots.dtype))
+        cur_node = node_off + count
+        if count >= _POS_MAJOR_MIN:
+            outs.append(fold_planes(slots, slot_off, cstride, count, pad_deg, op))
+        else:
+            block = jax.lax.dynamic_slice_in_dim(
+                flat, slot_off, count * pad_deg
+            ).reshape(count, pad_deg)
+            if op == "or":
+                outs.append(jnp.bitwise_or.reduce(block, axis=1))
             else:
-                # node-major small classes (count < _POS_MAJOR_MIN):
-                # reduce over the MINOR axis —
-                # reducing the major axis (or any tiny-minor reshape) gets
-                # canonicalized into a whole-buffer [X, count] layout with
-                # a 64x-padded minor dim (profiled: three such monsters at
-                # 129 ms per 32 rounds)
-                block = jax.lax.dynamic_slice_in_dim(
-                    flat, slot_off, count * pad_deg
-                ).reshape(count, pad_deg)
-                if op == "or":
-                    outs.append(jnp.bitwise_or.reduce(block, axis=1))
-                else:
-                    outs.append(jnp.sum(block, axis=1, dtype=slots.dtype))
-        return jnp.concatenate(outs)
+                outs.append(jnp.sum(block, axis=1, dtype=slots.dtype))
+    if n_out > cur_node:  # trailing pad rows
+        outs.append(jnp.zeros((n_out - cur_node,), slots.dtype))
+    return jnp.concatenate(outs)
 
 
 def quantile_degrees(
@@ -306,7 +345,11 @@ def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "rows", "classes", "interpret", "export_csr")
+    jax.jit,
+    static_argnames=(
+        "n", "rows", "classes", "interpret", "export_csr", "sentinel",
+        "int8_tables",
+    ),
 )
 def _build_plan(
     key,
@@ -317,7 +360,16 @@ def _build_plan(
     classes: tuple,
     interpret: bool | None,
     export_csr: bool = True,
+    sentinel: int | None = None,
+    int8_tables: bool | None = None,
 ):
+    """``sentinel``: CSR row absorbing erased edges. None (classic) appends
+    an extra row ``n`` (the DeviceGraph padding peer); the sharded layout
+    instead reuses its last per-shard pad row (state size must stay a
+    multiple of the mesh), so the CSR has exactly ``n`` rows. ``int8_tables``
+    overrides the narrow-table choice — the sharded build keys it on the
+    PER-SHARD row count (lane_shuffle's (32, 128) int8 tile granularity
+    must hold for each shard's block, not just the global array)."""
     r = rows
     # mixing depth: 128^K must reach every row or the matching is banded
     # (see MatchingPlan.stages); K=2 suffices to ~2M slots, 10M needs 3
@@ -326,7 +378,9 @@ def _build_plan(
 
     # --- random stage tables (int8 when the 32-row granularity allows:
     # lane ids < 128; at 10M each int32 table would cost 223 MB of HBM) ---
-    tdt = jnp.int8 if r % 32 == 0 else jnp.int32
+    if int8_tables is None:
+        int8_tables = r % 32 == 0
+    tdt = jnp.int8 if int8_tables else jnp.int32
     lanes = tuple(
         jnp.argsort(jax.random.uniform(keys[i], (r, 128)), axis=1).astype(tdt)
         for i in range(n_stages)
@@ -412,13 +466,16 @@ def _build_plan(
     # CSR — only churn re-wiring draws and the XLA twin paths do — and the
     # two ~D-element sorts here dominate the 10M build (VERDICT-grade
     # north-star accounting charges only what the config needs)
+    sent_row = n if sentinel is None else sentinel
+    n_rows = n + 1 if sentinel is None else n  # CSR rows incl. sentinel
     if export_csr:
-        src = jnp.where(valid, owner, n).reshape(-1)
-        dst = jnp.where(valid, other_owner, n).reshape(-1)
+        src = jnp.where(valid, owner, sent_row).reshape(-1)
+        dst = jnp.where(valid, other_owner, sent_row).reshape(-1)
         csr_order = jnp.argsort(src)
         col_idx = dst[csr_order]
         row_ptr = jnp.searchsorted(
-            src[csr_order], jnp.arange(n + 2, dtype=jnp.int32), side="left"
+            src[csr_order], jnp.arange(n_rows + 1, dtype=jnp.int32),
+            side="left",
         ).astype(jnp.int32)
     else:
         # degree-true row_ptr (state consumers read degrees off it) with an
@@ -428,13 +485,12 @@ def _build_plan(
             jnp.zeros((1,), jnp.int32),
             jnp.cumsum(deg_real, dtype=jnp.int32),
         ])
-        row_ptr = jnp.concatenate([row_ptr, row_ptr[-1:]])  # sentinel row
+        if sentinel is None:  # deg_real covers n rows; add the extra one
+            row_ptr = jnp.concatenate([row_ptr, row_ptr[-1:]])
         col_idx = jnp.zeros((1,), jnp.int32)
-    exists = jnp.arange(n + 1, dtype=jnp.int32) < n
 
     return (
         lanes, m3, lanes_inv, valid, deg_other, deg_real, row_ptr, col_idx,
-        exists,
     )
 
 
@@ -480,7 +536,6 @@ def matching_powerlaw_graph(
     deg = jnp.asarray(deg_host)
     (
         lanes, m3, lanes_inv, valid, deg_other, deg_real, row_ptr, col_idx,
-        exists,
     ) = _build_plan(
         key, deg, n=n, rows=rows, classes=classes, interpret=interpret,
         export_csr=export_csr,
@@ -489,6 +544,124 @@ def matching_powerlaw_graph(
         lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
         deg_other=deg_other, deg_real=deg_real,
         n=n, rows=rows, classes=classes, fanout=fanout,
+        mesh_shards=1, n_per=n, n_blk=n + 1, per_rows=rows,
+        local_classes=classes,
     )
+    exists = jnp.arange(n + 1, dtype=jnp.int32) < n
     graph = DeviceGraph(row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n)
+    return graph, plan
+
+
+def matching_powerlaw_graph_sharded(
+    n: int,
+    n_shards: int,
+    gamma: float = 2.5,
+    d_min: int = 2,
+    d_max: int | None = None,
+    *,
+    fanout: int | None = None,
+    key: jax.Array | None = None,
+    interpret: bool | None = None,
+    export_csr: bool = True,
+) -> tuple[DeviceGraph, MatchingPlan]:
+    """Structured-matching power-law swarm laid out for an ``n_shards`` mesh.
+
+    The mesh twin of :func:`matching_powerlaw_graph` — same erased
+    configuration model, same pairing algebra — with the slot array built
+    as ``n_shards`` IDENTICAL per-shard blocks so every per-round stage is
+    shard-local except the transpose passes (which become one dense
+    ``all_to_all`` each, kernels/permute.transpose_pass_sharded):
+
+    - each shard owns ``n_per = ceil(n / n_shards)`` peers whose degrees
+      are the quantile sequence of the SAME truncated-Pareto law over
+      ``n_per``. The d_max CAP comes from the global ``n``, but the
+      realized top degree only reaches the law's (1 - 1/(2·n_per))
+      quantile — identical per-shard blocks cannot hold one global-scale
+      hub, they hold ``n_shards`` copies of each degree value, so the
+      extreme tail is truncated by ~``n_shards^(1/(gamma-1))`` relative
+      to the unsharded family (at 1M/8, γ=2.5: top degree ~5.6k vs ~9k).
+      Documented generator semantics, like the class pad waste and the
+      swarm size rounding up to ``n_shards * n_per``;
+    - state rows: shard s owns ``[s*n_blk, (s+1)*n_blk)`` with
+      ``n_blk = n_per + 1`` (one born-dead pad row per shard, so the state
+      stays mesh-divisible; the LAST pad row doubles as the CSR sentinel
+      absorbing erased edges);
+    - slot rows: shard s owns ``[s*per_rows, (s+1)*per_rows)``, laid out
+      by ONE shared ``local_classes`` table (every shard's degree sequence
+      is identical, so the class plan is computed once). The plan's global
+      ``classes`` are the per-shard tables shifted by the block offsets —
+      ``slot_off``/``node_off`` remain the single source of truth for
+      expand, reduce, masking, and the fold kernel, globally AND per
+      shard.
+    - the pairing pipeline (lanes/m3 over the GLOBAL (R, 128) array, with
+      mixing depth from the global row count) spans shard boundaries, so
+      cross-shard edges exist exactly as in the unsharded family.
+
+    The returned plan runs unchanged through the LOCAL engine (its global
+    classes view) and through the dist engine
+    (dist/mesh.py ``gossip_round_dist``), which executes the identical
+    permutation per shard — single-chip and mesh trajectories are
+    bit-identical (tests/sim/test_dist.py).
+
+    Peer ids are (shard, degree-rank) ordered: id ``s*n_blk + j`` is shard
+    s's j-th-lowest-degree peer. Benchmarks seeding origins at low ids get
+    shard 0's minimum-degree peers — the same conservative side as the
+    unsharded family.
+
+    Scale note: each shard's slot rows round up to 8-row (1024-slot)
+    granularity, and the dead tail pairs with real stubs and erases them —
+    at ``n / n_shards`` below a few thousand peers the tail is a large
+    slot fraction and the realized graph noticeably sparser than the law
+    (the classic build has the same artifact an order of magnitude lower).
+    Real workloads (>= ~100k peers per shard) see sub-percent erasure.
+    """
+    if key is None:
+        key = jax.random.key(0)
+    s = n_shards
+    if s < 1 or 128 % s:
+        raise ValueError(
+            f"n_shards={s} must divide 128 (the transpose all_to_all splits "
+            "the lane axis)"
+        )
+    if d_max is None:
+        d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
+    n_per = -(-n // s)
+    deg_local = quantile_degrees(n_per, gamma, d_min, d_max)
+    local_classes = _plan_classes(deg_local)
+    last = local_classes[-1]
+    n_slots_local = last[1] + last[3] * last[4]
+    # per-shard row granularity: int8 stage tables need each shard's block
+    # to hold whole (32, 128) tiles, so the narrow-table choice keys on
+    # per_rows, not the global row count
+    gran = 32 if n_slots_local * s >= (1 << 19) else 8
+    per_rows = math.ceil(n_slots_local / (128 * gran)) * gran
+    rows = per_rows * s
+    n_blk = n_per + 1
+    n_state = s * n_blk
+    classes = tuple(
+        (sh * n_blk + no, sh * per_rows * 128 + so, c, pd, cs)
+        for sh in range(s)
+        for (no, so, c, pd, cs) in local_classes
+    )
+    deg_state = np.zeros(n_state, dtype=np.int32)
+    for sh in range(s):
+        deg_state[sh * n_blk : sh * n_blk + n_per] = deg_local
+    (
+        lanes, m3, lanes_inv, valid, deg_other, deg_real, row_ptr, col_idx,
+    ) = _build_plan(
+        key, jnp.asarray(deg_state), n=n_state, rows=rows, classes=classes,
+        interpret=interpret, export_csr=export_csr,
+        sentinel=n_state - 1, int8_tables=(per_rows % 32 == 0),
+    )
+    plan = MatchingPlan(
+        lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
+        deg_other=deg_other, deg_real=deg_real,
+        n=n_state, rows=rows, classes=classes, fanout=fanout,
+        mesh_shards=s, n_per=n_per, n_blk=n_blk, per_rows=per_rows,
+        local_classes=local_classes,
+    )
+    exists = jnp.asarray((np.arange(n_state) % n_blk) < n_per)
+    graph = DeviceGraph(
+        row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n_state - 1
+    )
     return graph, plan

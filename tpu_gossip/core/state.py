@@ -227,11 +227,16 @@ def message_slots(
     # int ids hash through the same seeded FNV over their bytes: an affine
     # per-plane mix (id + plane*c) * c' is NOT independent across planes —
     # for power-of-two M the plane offset cancels and k>1 degenerates to
-    # k=1 conflation for integer ids
+    # k=1 conflation for integer ids. Ids are masked to 64 bits BEFORE
+    # serialization: two's complement makes the masked unsigned bytes
+    # identical to the old signed encoding for every id in [-2^63, 2^63),
+    # so the historical slot mapping is preserved exactly, while ids
+    # outside that range (e.g. uuid.int, 128-bit content hashes) now wrap
+    # instead of raising OverflowError (see docs/dedup_semantics.md).
     data = (
         message_id.encode()
         if isinstance(message_id, str)
-        else int(message_id).to_bytes(8, "little", signed=True)
+        else (int(message_id) & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
     )
     out = []
     for plane in range(k):

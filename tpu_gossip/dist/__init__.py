@@ -7,6 +7,8 @@ pre-bucketed by (source shard → destination shard), and a gossip round's
 fan-out is one ``all_to_all`` inside ``shard_map``.
 """
 
+from tpu_gossip.dist._compat import shard_map_compat
+from tpu_gossip.dist.matching_mesh import shard_matching_plan
 from tpu_gossip.dist.mesh import (
     ShardedGraph,
     ShardPlans,
@@ -28,6 +30,8 @@ __all__ = [
     "partition_graph",
     "build_shard_plans",
     "shard_swarm",
+    "shard_matching_plan",
+    "shard_map_compat",
     "init_sharded_swarm",
     "repartition_swarm",
     "gossip_round_dist",

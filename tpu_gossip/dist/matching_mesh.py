@@ -1,0 +1,408 @@
+"""Sharded matching delivery: the gather-free pipeline, multi-chip.
+
+The structured-matching round (kernels/matching.py) is three streaming
+stages — expand, pairing pipeline, reduce — over a class-major slot array.
+Under the per-shard layout of
+:func:`~tpu_gossip.core.matching_topology.matching_powerlaw_graph_sharded`
+every stage is shard-local except the transpose passes:
+
+- expand / reduce / fold / masks / sampling gates: each shard owns
+  ``n_blk`` state rows and ``per_rows`` slot rows laid out by ONE shared
+  ``local_classes`` table, so the SAME expand/reduce code
+  (core/matching_topology.expand_classes / reduce_classes) runs per shard
+  with zero communication;
+- lane shuffles: row-local Pallas, zero communication;
+- transpose passes: THE communication — each is one dense, perfectly
+  rectangular ``lax.all_to_all`` tile exchange
+  (kernels/permute.transpose_pass_sharded), ~2K+1 of them per pipeline
+  application for K transpose stages. No ragged-bucket padding exists
+  anywhere, unlike the CSR bucket engine (dist/mesh.py _exchange).
+
+Sampling gates are drawn OUTSIDE ``shard_map`` with the plan's GLOBAL
+(R, 128) shape — threefry bits are position-deterministic, so the mesh
+draws the identical uint32 stream the local engine draws — and the key
+discipline mirrors ``sim.engine.gossip_round`` / ``_disseminate_local``
+split for split. Together with the transposes computing the identical
+global bijection, a mesh round is BIT-IDENTICAL to the local engine's
+round on the same plan (tests/sim/test_dist.py asserts full-trajectory
+equality) — the strongest correctness statement a distributed round can
+make, and one the bucketed CSR engine (different activation geometry) can
+only approach in distribution.
+
+Churn re-wiring composes exactly as in the local kernel path: the static
+pipeline carries the bulk (rewired senders zeroed pre-pack, rewired
+receivers row-masked), and the rejoiners' sparse fresh-edge traffic rides
+``sim.engine.fresh_rewire_traffic`` outside ``shard_map``, where XLA's
+SPMD partitioner inserts the collectives. Re-materialization
+(``rematerialize_rewired``) changes the CSR, which the pairing cannot
+absorb — the fallback for that lifecycle is the bucketed-CSR route:
+``partition_graph`` on the plan's exported CSR (cli/run_sim.py wires it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_gossip.core.matching_topology import (
+    MatchingPlan,
+    expand_classes,
+    pipeline_stages,
+    reduce_classes,
+)
+from tpu_gossip.core.state import SwarmConfig, SwarmState
+from tpu_gossip.dist._compat import shard_map_compat
+from tpu_gossip.kernels.pallas_segment import (
+    _slot_groups,
+    bernoulli_threshold_device,
+    pack_words,
+    unpack_words,
+)
+from tpu_gossip.kernels.permute import apply_pipeline
+
+__all__ = [
+    "shard_matching_plan",
+    "gossip_round_dist_matching",
+]
+
+AXIS = "peers"
+
+
+def shard_matching_plan(plan: MatchingPlan, mesh: Mesh) -> MatchingPlan:
+    """Place the plan's slot-row tables and node arrays onto the mesh.
+
+    Every (R, 128) table row-shards on the peer axis (shard s's block is
+    its ``per_rows`` rows of each stage table); ``deg_real`` (n_state,)
+    shards like the state. One ``device_put`` per array, once per plan —
+    the round path then moves no table bytes at all.
+    """
+    import dataclasses
+
+    if plan.mesh_shards != mesh.size:
+        raise ValueError(
+            f"plan laid out for {plan.mesh_shards} shards but mesh has "
+            f"{mesh.size} devices — rebuild with "
+            f"matching_powerlaw_graph_sharded(n, {mesh.size})"
+        )
+    row = NamedSharding(mesh, P(AXIS))
+    put = functools.partial(jax.device_put, device=row)
+    return dataclasses.replace(
+        plan,
+        lanes=tuple(put(t) for t in plan.lanes),
+        m3=put(plan.m3),
+        lanes_inv=tuple(put(t) for t in plan.lanes_inv),
+        valid=put(plan.valid),
+        deg_other=None if plan.deg_other is None else put(plan.deg_other),
+        deg_real=None if plan.deg_real is None else put(plan.deg_real),
+    )
+
+
+def _local_stages(lane_blks, m3_blk, lanes_inv_blks) -> tuple:
+    """MatchingPlan.stages rebuilt from shard-local table blocks — the ONE
+    composition (core.matching_topology.pipeline_stages) applied to the
+    blocks, so the mesh can never drift from the local pairing order."""
+    return pipeline_stages(tuple(lane_blks), m3_blk, tuple(lanes_inv_blks))
+
+
+def _matching_exchange_dist(
+    plan: MatchingPlan,
+    mesh: Mesh,
+    transmit: jax.Array,
+    answer: jax.Array | None,
+    m: int,
+    key: jax.Array,
+    *,
+    receptive_rows: jax.Array | None = None,
+    do_push: bool = True,
+    do_pull: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sampled matching delivery on the mesh — the contract (and the bits)
+    of ``kernels.matching.matching_sampled``.
+
+    Packing, push gates, and the final receptive row mask are elementwise
+    over already-sharded arrays, so they run OUTSIDE ``shard_map`` (the
+    partitioner keeps them sharded; the RNG stream is position-exact vs
+    the local engine). Expand, the pipeline (lane shuffles + all_to_all
+    transposes), pull gates (they need the shard-local expand of
+    ``deg_real``), reduce, and billing run per shard inside.
+    """
+    if plan.fanout is None or plan.deg_other is None:
+        raise ValueError("plan built without fanout — no sampling gates")
+    s = plan.mesh_shards
+    groups = _slot_groups(m)
+    shape = (plan.rows, 128)
+    k_push, k_pull = jax.random.split(key)
+
+    tx_words = jnp.stack(
+        [pack_words(transmit[: plan.n, lo : lo + w]) for lo, w in groups],
+        axis=-1,
+    )  # (n_state, G)
+    ans_words = None
+    if do_pull and answer is not None:
+        ans_words = jnp.stack(
+            [pack_words(answer[: plan.n, lo : lo + w]) for lo, w in groups],
+            axis=-1,
+        )
+    # edge activation drawn once, global shape, shared across word groups —
+    # bit-identical to matching_sampled's draws on the same key
+    active_p = (
+        jax.random.bits(k_push, shape, jnp.uint32) < plan.push_threshold()
+        if do_push
+        else None
+    )
+    bits_q = (
+        jax.random.bits(k_pull, shape, jnp.uint32) if do_pull else None
+    )
+
+    local_classes, per_rows, n_blk = (
+        plan.local_classes, plan.per_rows, plan.n_blk,
+    )
+    has_rec = receptive_rows is not None
+    operands = [tx_words]
+    if ans_words is not None:
+        operands.append(ans_words)
+    if active_p is not None:
+        operands.append(active_p)
+    if do_pull:
+        operands += [bits_q, plan.valid, plan.deg_real]
+        if has_rec:
+            operands.append(receptive_rows)
+    operands += list(plan.lanes) + [plan.m3] + list(plan.lanes_inv)
+    k_stages = len(plan.lanes)
+
+    @functools.partial(
+        shard_map_compat,
+        mesh=mesh,
+        in_specs=(P(AXIS),) * len(operands),
+        out_specs=(P(AXIS), P(AXIS)),
+        # lane shuffles and the fold kernel launch pallas_call with
+        # shard-varying tables, which the replication checker cannot type
+        # (same reason as dist/mesh.py's staircase receive)
+        check_vma=False,
+    )
+    def ex(*blks):
+        it = iter(blks)
+        txw = next(it)  # (n_blk, G)
+        answ = next(it) if ans_words is not None else None
+        act_p = next(it) if active_p is not None else None
+        if do_pull:
+            bq, valid_blk, deg_real_blk = next(it), next(it), next(it)
+            rec_blk = next(it) if has_rec else None
+        lane_blks = [next(it) for _ in range(k_stages)]
+        m3_blk = next(it)
+        lanes_inv_blks = [next(it) for _ in range(k_stages)]
+        stages = _local_stages(lane_blks, m3_blk, lanes_inv_blks)
+
+        def partner(x):
+            return apply_pipeline(
+                x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+            )
+
+        msgs = jnp.zeros((), jnp.int32)
+        act_q = pull_bill = rec_slots = None
+        if do_pull:
+            # pull gate: B(1/deg(puller)) per slot — needs the shard-local
+            # expand of deg_real (the same elementwise law as
+            # MatchingPlan.pull_threshold, block-local)
+            deg_self = expand_classes(deg_real_blk, local_classes, per_rows)
+            thresh_q = jnp.where(
+                valid_blk & (deg_self > 0),
+                bernoulli_threshold_device(
+                    1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)
+                ),
+                jnp.uint32(0),
+            )
+            act_q = bq < thresh_q
+            pull_bill = act_q.astype(jnp.int32)
+            if rec_blk is not None:
+                rec_slots = (
+                    expand_classes(
+                        rec_blk.astype(jnp.int32), local_classes, per_rows
+                    )
+                    > 0
+                )
+        outs = []
+        for gi, (_, w) in enumerate(groups):
+            slot_tx = partner(
+                expand_classes(txw[:, gi], local_classes, per_rows)
+            )
+            combined = jnp.zeros((per_rows, 128), jnp.int32)
+            if act_p is not None:
+                wp = jnp.where(act_p, slot_tx, 0)
+                combined = combined | wp
+                msgs = msgs + jnp.sum(
+                    jax.lax.population_count(wp), dtype=jnp.int32
+                )
+            if do_pull:
+                slot_ans = (
+                    slot_tx
+                    if answ is None
+                    else partner(
+                        expand_classes(answ[:, gi], local_classes, per_rows)
+                    )
+                )
+                wq = jnp.where(act_q, slot_ans, 0)
+                combined = combined | wq
+                pull_bill = pull_bill + jax.lax.population_count(wq)
+            outs.append(
+                unpack_words(
+                    reduce_classes(combined, local_classes, n_blk, "or"), w
+                )
+            )
+        incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        if do_pull:
+            if rec_slots is not None:
+                pull_bill = jnp.where(rec_slots, pull_bill, 0)
+            msgs = msgs + jnp.sum(pull_bill, dtype=jnp.int32)
+        return incoming, msgs[None]
+
+    incoming, msgs = ex(*operands)
+    if has_rec:
+        incoming = incoming & receptive_rows[:, None]
+    return incoming, jnp.sum(msgs)
+
+
+def _matching_flood_dist(
+    plan: MatchingPlan,
+    mesh: Mesh,
+    transmit: jax.Array,
+    m: int,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flood delivery on the mesh — ``kernels.matching.matching_flood``
+    per shard (deterministic: no gates, no billing — the engine bills
+    flood off CSR degrees)."""
+    s = plan.mesh_shards
+    groups = _slot_groups(m)
+    tx_words = jnp.stack(
+        [pack_words(transmit[: plan.n, lo : lo + w]) for lo, w in groups],
+        axis=-1,
+    )
+    local_classes, per_rows, n_blk = (
+        plan.local_classes, plan.per_rows, plan.n_blk,
+    )
+    k_stages = len(plan.lanes)
+    operands = (
+        [tx_words, plan.valid] + list(plan.lanes) + [plan.m3]
+        + list(plan.lanes_inv)
+    )
+
+    @functools.partial(
+        shard_map_compat,
+        mesh=mesh,
+        in_specs=(P(AXIS),) * len(operands),
+        out_specs=P(AXIS),
+        check_vma=False,
+    )
+    def ex(*blks):
+        it = iter(blks)
+        txw, valid_blk = next(it), next(it)
+        lane_blks = [next(it) for _ in range(k_stages)]
+        m3_blk = next(it)
+        lanes_inv_blks = [next(it) for _ in range(k_stages)]
+        stages = _local_stages(lane_blks, m3_blk, lanes_inv_blks)
+        outs = []
+        for gi, (_, w) in enumerate(groups):
+            across = apply_pipeline(
+                expand_classes(txw[:, gi], local_classes, per_rows),
+                stages, interpret=interpret, axis_name=AXIS, n_shards=s,
+            )
+            across = jnp.where(valid_blk, across, 0)
+            outs.append(
+                unpack_words(
+                    reduce_classes(across, local_classes, n_blk, "or"), w
+                )
+            )
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+    return ex(*operands)
+
+
+def gossip_round_dist_matching(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    plan: MatchingPlan,
+    mesh: Mesh,
+) -> tuple[SwarmState, "jax.Array"]:
+    """One multi-chip matching round: sharded pipeline + shared protocol
+    tail.
+
+    Key splits mirror ``sim.engine.gossip_round`` + ``_disseminate_local``
+    exactly, and the exchange draws the same RNG stream — the round is
+    bit-identical to the local engine on the same plan and state. Churn
+    re-wiring masks the static pipeline like the local kernel path and
+    routes fresh-edge traffic through
+    ``sim.engine.fresh_rewire_traffic`` outside ``shard_map``.
+    """
+    from tpu_gossip.sim.engine import (
+        advance_round,
+        compute_roles,
+        fresh_rewire_traffic,
+        kernel_path_masks,
+        transmit_bitmap,
+        validate_rewire_width,
+    )
+
+    if plan.mesh_shards != mesh.size:
+        raise ValueError(
+            f"plan laid out for {plan.mesh_shards} shards but mesh has "
+            f"{mesh.size} devices — rebuild with "
+            f"matching_powerlaw_graph_sharded(n, {mesh.size})"
+        )
+    validate_rewire_width(state, cfg)
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    k_push, k_rw_push = jax.random.split(k_push)
+    k_pull, k_rw_pull = jax.random.split(k_pull)
+    _, transmitter, receptive = compute_roles(state)
+    transmit = transmit_bitmap(state, cfg, transmitter)
+
+    incoming = jnp.zeros_like(state.seen)
+    msgs_sent = jnp.zeros((), dtype=jnp.int32)
+    if cfg.mode in ("push", "push_pull"):
+        if plan.fanout is None or plan.deg_other is None:
+            raise ValueError(
+                "sampled matching delivery needs a plan built with fanout= "
+                "(matching_powerlaw_graph_sharded(..., fanout=cfg.fanout))"
+            )
+        if plan.fanout != cfg.fanout:
+            raise ValueError(
+                f"plan built for fanout={plan.fanout} but cfg.fanout="
+                f"{cfg.fanout}"
+            )
+        tx, answer, rec_rows = kernel_path_masks(
+            state, cfg, transmit, transmitter, receptive
+        )
+        inc, msgs = _matching_exchange_dist(
+            plan, mesh, tx, answer, cfg.msg_slots, k_push,
+            receptive_rows=rec_rows,
+            do_push=True, do_pull=(cfg.mode == "push_pull"),
+        )
+        incoming = incoming | inc
+        msgs_sent = msgs_sent + msgs
+        if cfg.rewire_slots > 0:
+            fresh_inc, fresh_msgs = fresh_rewire_traffic(
+                state, cfg, transmit, state.seen & transmitter,
+                receptive.any(-1), k_rw_push, k_rw_pull,
+                do_pull=(cfg.mode == "push_pull"),
+            )
+            incoming = incoming | fresh_inc
+            msgs_sent = msgs_sent + fresh_msgs
+    if cfg.mode == "flood":
+        incoming = incoming | _matching_flood_dist(
+            plan, mesh, transmit, cfg.msg_slots
+        )
+        deg = state.row_ptr[1:] - state.row_ptr[:-1]
+        msgs_sent = msgs_sent + jnp.sum(
+            transmit.sum(-1, dtype=jnp.int32) * deg
+        )
+
+    return advance_round(
+        state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join,
+        receptive,
+    )

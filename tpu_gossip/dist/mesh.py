@@ -45,6 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm
 from tpu_gossip.core.topology import Graph, build_csr
+from tpu_gossip.dist._compat import shard_map_compat
+from tpu_gossip.dist.matching_mesh import gossip_round_dist_matching
 from tpu_gossip.sim.engine import (
     RoundStats,
     advance_round,
@@ -525,7 +527,7 @@ def _exchange(
     merged = activation == "push_pull"
 
     @functools.partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(AXIS),) * (8 + len(plan_args)),
         out_specs=(P(AXIS), P(AXIS)),
@@ -634,11 +636,18 @@ def _exchange(
 def gossip_round_dist(
     state: SwarmState,
     cfg: SwarmConfig,
-    sg: ShardedGraph,
+    sg: "ShardedGraph | object",
     mesh: Mesh,
     shard_plan: ShardPlans | None = None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
+
+    ``sg`` selects the delivery engine: a :class:`ShardedGraph` runs the
+    bucketed CSR exchange below (any imported/repartitioned topology); a
+    :class:`~tpu_gossip.core.matching_topology.MatchingPlan` (built by
+    ``matching_powerlaw_graph_sharded``) runs the gather-free matching
+    pipeline with its transposes as dense ``all_to_all`` collectives
+    (dist/matching_mesh.py) — bit-identical to the local matching round.
 
     With churn re-wiring (``cfg.rewire_slots > 0``, push/push_pull), the
     static bucket traffic is masked the way the local engine masks stale
@@ -649,6 +658,16 @@ def gossip_round_dist(
     XLA's SPMD partitioner inserts the collectives). Flood mode ignores
     re-wiring (both
     engines: the flood is defined over the static CSR)."""
+    from tpu_gossip.core.matching_topology import MatchingPlan
+
+    if isinstance(sg, MatchingPlan):
+        if shard_plan is not None:
+            raise ValueError(
+                "shard_plan is the bucketed CSR engine's staircase receive; "
+                "matching delivery has no scatter to replace — pass "
+                "shard_plan=None"
+            )
+        return gossip_round_dist_matching(state, cfg, sg, mesh)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "

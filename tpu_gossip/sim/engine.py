@@ -40,6 +40,7 @@ __all__ = [
     "RoundStats",
     "compute_roles",
     "transmit_bitmap",
+    "kernel_path_masks",
     "validate_rewire_width",
     "reverse_fresh_push",
     "fresh_rewire_traffic",
@@ -101,6 +102,34 @@ def transmit_bitmap(
     return transmit
 
 
+def kernel_path_masks(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    transmit: jax.Array,
+    transmitter: jax.Array,
+    receptive: jax.Array,
+) -> tuple[jax.Array, jax.Array | None, jax.Array]:
+    """(tx, answer, rec_rows) for sampled kernel-family delivery.
+
+    THE protocol head shared by the local kernel paths
+    (:func:`_disseminate_local`) and the matching mesh engine
+    (dist/matching_mesh.py) — it exists once because the mesh round's
+    bit-identity guarantee rests on both engines masking identically:
+    pull answers ship the responder's full seen set (forward_once budgets
+    gate pushing, never answering; ``None`` = same array as transmit),
+    and under churn re-wiring a rewired sender's static edges carry
+    nothing, a rewired receiver accepts nothing over them.
+    """
+    answer = (state.seen & transmitter) if cfg.forward_once else None
+    tx, rec_rows = transmit, receptive.any(-1)
+    if cfg.rewire_slots > 0:
+        tx = tx & ~state.rewired[:, None]
+        if answer is not None:
+            answer = answer & ~state.rewired[:, None]
+        rec_rows = rec_rows & ~state.rewired
+    return tx, answer, rec_rows
+
+
 def _disseminate_local(
     state: SwarmState,
     cfg: SwarmConfig,
@@ -151,15 +180,9 @@ def _disseminate_local(
             raise ValueError(
                 f"plan built for fanout={plan.fanout} but cfg.fanout={cfg.fanout}"
             )
-        # pull ships the responder's full seen set (forward_once budgets
-        # gate pushing, never answering) — None = same array as transmit
-        answer = (state.seen & transmitter) if cfg.forward_once else None
-        tx, rec_rows = transmit, receptive.any(-1)
-        if cfg.rewire_slots > 0:
-            tx = tx & ~state.rewired[:, None]
-            if answer is not None:
-                answer = answer & ~state.rewired[:, None]
-            rec_rows = rec_rows & ~state.rewired
+        tx, answer, rec_rows = kernel_path_masks(
+            state, cfg, transmit, transmitter, receptive
+        )
         deliver = (
             matching_sampled if isinstance(plan, MatchingPlan) else segment_sampled
         )
@@ -548,8 +571,19 @@ def _substitute_rewired(
     )
 
 
+def _is_csr_free(state: SwarmState) -> bool:
+    """The CSR-free sentinel SHAPE, tested exactly: a matching graph built
+    with export_csr=False carries col_idx of shape (1,) (one zero entry —
+    core/matching_topology._build_plan). A genuinely edgeless graph has
+    col_idx of shape (0,) and real CSRs carry both directions of >= 1 edge
+    (>= 2 entries) — neither is (1,), so the heuristic cannot misfire on
+    them (the old ``<= 1`` test rejected edgeless graphs with a misleading
+    export_csr=False message)."""
+    return state.col_idx.shape[0] == 1 and state.row_ptr.shape[0] > 3
+
+
 def _require_csr(state: SwarmState, what: str) -> None:
-    if state.col_idx.shape[0] <= 1 and state.row_ptr.shape[0] > 3:
+    if _is_csr_free(state):
         raise ValueError(
             f"{what} reads the CSR neighbor list, but this graph was built "
             "without one (matching_powerlaw_graph(export_csr=False)) — XLA "
@@ -569,13 +603,17 @@ def validate_rewire_width(state: SwarmState, cfg: SwarmConfig) -> None:
             "checkpoint was saved with fewer slots; pad rewire_targets or "
             "lower rewire_slots"
         )
-    if cfg.rewire_slots > 0 and cfg.churn_join_prob > 0 and (
-        state.col_idx.shape[0] <= 1
+    if cfg.rewire_slots > 0 and cfg.churn_join_prob > 0 and _is_csr_free(
+        state
     ):
         # a CSR-free graph (matching_powerlaw_graph(export_csr=False))
         # carries a 1-entry col_idx; the degree-preferential endpoint draws
         # would gather out of bounds, which XLA silently CLAMPS to entry 0
-        # — every rejoiner would attach to peer 0 with no error raised
+        # — every rejoiner would attach to peer 0 with no error raised.
+        # The sentinel is the exact (1,) shape (_is_csr_free): an edgeless
+        # CSR (col_idx (0,)) is not CSR-free, just empty — its endpoint
+        # draws find no targets and every rewire stays invalid, which is
+        # correct behavior, not an export error
         raise ValueError(
             "churn re-wiring needs the neighbor list: this graph was built "
             "without a CSR export (matching_powerlaw_graph(export_csr="
@@ -653,12 +691,15 @@ def advance_round(
         silent = silent & ~fresh
         last_hb = jnp.where(fresh, rnd, last_hb)
         declared_dead = declared_dead & ~fresh
-        if cfg.rewire_slots > 0:
+        if cfg.rewire_slots > 0 and state.col_idx.shape[0] > 0:
             # power-law re-wiring: the arriving peer attaches its fresh
             # edges degree-preferentially. A uniform index into the CSR
             # endpoint list IS degree-proportional sampling — the
             # repeated-endpoints trick of the reference's intended selector
-            # (demonstrate_powerlaw.py:5-39).
+            # (demonstrate_powerlaw.py:5-39). An EDGELESS CSR (col_idx
+            # shape (0,), a static property) has no endpoints to draw:
+            # joiners rejoin on their slot's (empty) edges un-rewired
+            # instead of gathering from a zero-length array.
             n, s = rewire_targets.shape
             # draw indices in [0, row_ptr[-1]) — the REAL edge span — not
             # [0, len(col_idx)): a re-materialized CSR (rematerialize_rewired)
