@@ -57,6 +57,17 @@ are not self-referential. Per-config ``access_rate_per_sec_M`` uses the
 random-access ceiling as denominator: dissemination is bound by random
 gather/scatter access rate, not FLOPs (SURVEY.md §5.1 accounting).
 
+Every record carries ``lint_clean``: the graftlint AST-rule verdict
+(tpu_gossip/analysis, docs/static_analysis.md) for the tree that produced
+the numbers — so a benchmark artifact from an invariant-dirty tree is
+visibly marked. ``--quick`` runs never clobber a full run's measurements,
+but they DO refresh the ``lint_clean``/``lint`` fields in
+BENCH_DETAIL.json. The r5 ``patch_note`` hand-patch mechanism is retired:
+full runs emit no patch/provenance fields (the record IS what this script
+measured), and the committed record's ``provenance_note`` — disclosing
+the r5 entries that were hand-re-measured — rides along until the next
+full hardware bench rewrites the record from scratch.
+
 Flags: --quick (1M only, 1 rep, skips the sharded-engine entry — the smoke
 invocation, see README) · --dist (force the sharded-engine run even under
 --quick) · --profile DIR (jax.profiler trace of one warmed headline run).
@@ -416,6 +427,27 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     }
 
 
+def _lint_status() -> dict:
+    """graftlint verdict for the tree being benchmarked (AST rules only —
+    sub-second; the eval_shape contract audit belongs to CI, not to every
+    bench invocation). Never raises: a crashed linter is itself recorded,
+    not silently dropped."""
+    try:
+        from tpu_gossip.analysis import run_repo_lint
+
+        res = run_repo_lint()
+        return {
+            "lint_clean": bool(res["clean"]),
+            "lint": {
+                "new_findings": len(res["new"]),
+                "baselined": res["baselined"],
+                "scope": "ast-rules",
+            },
+        }
+    except Exception as e:  # noqa: BLE001 — record, don't kill the bench
+        return {"lint_clean": False, "lint": {"error": repr(e)[:200]}}
+
+
 def _timed_coverage(run, n: int, reps: int):
     """Warm + min-wall timing of a zero-arg run-to-coverage callable (the
     scalar fetch is the completion barrier on the axon tunnel)."""
@@ -616,6 +648,7 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_gossip.utils.profiling import trace
 
     reps = 1 if quick else 3
+    lint_status = _lint_status()
     ceilings = _measure_ceilings(jax, jnp)
 
     # --- 1M graph + staircase plans --------------------------------------
@@ -667,6 +700,7 @@ def main(argv: list[str] | None = None) -> int:
         },
         "budget_seconds": budget_s,
         "sections_skipped": [],
+        **lint_status,
     }
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
@@ -676,8 +710,25 @@ def main(argv: list[str] | None = None) -> int:
         """Write the record INCREMENTALLY — each completed section lands
         before the next begins, so a killed run still leaves a truthful
         committed artifact. --quick smoke runs never clobber a full run's
-        record."""
+        MEASUREMENTS — they refresh ONLY the analyzer verdict fields. Any
+        ``provenance_note`` disclosing hand-patched entries stays with the
+        numbers it describes; a FULL run rewrites the record wholesale
+        from its own measurements, which is when such notes clear
+        (VERDICT r5 item 2: the committed record must be what a re-run of
+        this script produces — full runs emit no patch/provenance notes)."""
         if quick:
+            rec = {}
+            if os.path.exists(detail_path):
+                try:
+                    with open(detail_path) as f:
+                        rec = json.load(f)
+                except ValueError:
+                    rec = {}  # corrupt record: rebuild the lint stub
+            rec["lint_clean"] = lint_status["lint_clean"]
+            rec["lint"] = lint_status["lint"]
+            with open(detail_path, "w") as f:
+                json.dump(rec, f, indent=1, sort_keys=True)
+                f.write("\n")
             return
         out["elapsed_seconds"] = round(elapsed(), 1)
         with open(detail_path, "w") as f:
@@ -700,8 +751,6 @@ def main(argv: list[str] | None = None) -> int:
     # section can no longer lose it (the final, enriched compact line is
     # printed again at exit; tail-parsing reads the most complete one)
     early = {**_compact(out), "partial": True}
-    if quick:
-        early["detail_file"] = None
     print(json.dumps(early), flush=True)
     flush_detail()
 
@@ -977,11 +1026,10 @@ def main(argv: list[str] | None = None) -> int:
 
     # stdout's LAST line is the enriched compact headline (the early print
     # after the 1M trio covers driver-timeout deaths; this one supersedes
-    # it when the run completes). --quick runs never write the record.
+    # it when the run completes). --quick touches only the record's
+    # lint_clean/lint fields (flush_detail).
     flush_detail()
     compact = _compact(out)
-    if quick:
-        compact["detail_file"] = None  # quick runs don't write the record
     print(json.dumps(compact), flush=True)
     return 0
 
@@ -995,7 +1043,7 @@ def _compact(out: dict) -> dict:
         k: out[k]
         for k in (
             "metric", "value", "unit", "vs_baseline", "rounds_to_99pct",
-            "wall_seconds", "headline_delivery",
+            "wall_seconds", "headline_delivery", "lint_clean",
         )
         if k in out
     }

@@ -1,0 +1,58 @@
+"""Fixture: every shape of PRNG key reuse graftlint must catch.
+
+NOT importable production code — linted as text by tests/analysis.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def double_sample(key):
+    a = jax.random.uniform(key, (4,))
+    b = jax.random.normal(key, (4,))  # reuse: same key, second sampler
+    return a + b
+
+
+def sample_then_split(key):
+    u = jax.random.uniform(key, (4,))
+    k1, k2 = jax.random.split(key)  # reuse: key already consumed
+    return u, k1, k2
+
+
+def double_split(key):
+    ka = jax.random.split(key, 2)
+    kb = jax.random.split(key, 3)  # reuse: identical leading subkeys
+    return ka, kb
+
+
+def loop_reuse(key, n):
+    out = jnp.zeros(())
+    for _ in range(n):
+        out = out + jax.random.uniform(key)  # reuse across iterations
+    return out
+
+
+def transfer_then_sample(key, helper):
+    x = helper(key)  # ownership moved to the callee
+    return x + jax.random.uniform(key)  # reuse after transfer
+
+
+def inline_root_key():
+    return jax.random.uniform(jax.random.key(0), (4,))  # constant stream
+
+
+def scan_body_captures_key(key, xs):
+    def body(carry, x):
+        # captured key consumed per ITERATION: one value, many draws
+        return carry + jax.random.bernoulli(key, 0.5), x
+
+    out, _ = jax.lax.scan(body, jnp.zeros(()), xs)
+    return out
+
+
+def closure_capture_then_outer_use(key):
+    def helper():
+        return jax.random.uniform(key)  # consumes the captured key
+
+    a = helper()
+    return a + jax.random.normal(key)  # reuse after closure consumption

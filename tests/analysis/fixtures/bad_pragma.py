@@ -1,0 +1,14 @@
+"""Fixture: pragma misuse graftlint must catch."""
+
+import jax
+
+
+def reasonless(key):
+    a = jax.random.uniform(key)
+    b = jax.random.uniform(key)  # graftlint: disable=key-linearity
+    return a + b
+
+
+def unknown_rule(key):
+    # graftlint: disable=no-such-rule -- typo'd rule id must be reported
+    return jax.random.uniform(key)

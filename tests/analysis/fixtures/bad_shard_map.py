@@ -1,0 +1,18 @@
+"""Fixture: raw shard_map references graftlint must catch."""
+
+import jax
+from jax.experimental.shard_map import shard_map  # raw import
+
+
+def raw_attribute(f, mesh, specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+
+
+def raw_experimental(f, mesh, specs):
+    return jax.experimental.shard_map.shard_map(
+        f, mesh=mesh, in_specs=specs, out_specs=specs
+    )
+
+
+def raw_from_import(f, mesh, specs):
+    return shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)

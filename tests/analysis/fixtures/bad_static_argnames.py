@@ -1,0 +1,27 @@
+"""Fixture: static_argnames drift graftlint must catch."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "capactiy"))  # typo'd
+def renamed_param(state, cfg, capacity: int):
+    return state[:capacity]
+
+
+@jax.jit(static_argnames="num_rouns")  # the parameter is num_rounds
+def direct_call_form(state, num_rounds: int):
+    return state * num_rounds
+
+
+@functools.partial(jax.jit, static_argnums=(3,))  # only 2 positional params
+def nums_out_of_range(state, n):
+    return state + n
+
+
+def wrapped(state, mode):
+    return state
+
+
+jitted = jax.jit(wrapped, static_argnames=("moed",))  # assignment form

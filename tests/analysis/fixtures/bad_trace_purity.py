@@ -1,0 +1,57 @@
+"""Fixture: host syncs / impurity inside jit-reachable code."""
+
+import functools
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def wall_clock(x):
+    t = time.time()  # host clock baked into the trace
+    return x * t
+
+
+@jax.jit
+def host_rng(x):
+    return x + random.random()  # stdlib RNG: one host draw at trace time
+
+
+@jax.jit
+def numpy_rng(x):
+    return x + np.random.uniform()  # numpy RNG: same trace-time bake
+
+
+@jax.jit
+def materialize(x):
+    h = np.asarray(x)  # device->host materialization
+    return jnp.asarray(h)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def cast_traced(x, n: int):
+    scale = float(x[0])  # host sync on a traced value
+    return x * scale * n
+
+
+@jax.jit
+def item_sync(x):
+    return x.sum().item()  # .item() forces a device->host sync
+
+
+@jax.jit
+def item_sync_attribute_chain(state):
+    # the COMMON form: .item() hanging off an attribute chain
+    return state.coverage.item()
+
+
+def helper_impure(x):
+    return x * time.perf_counter()  # impure; reachable via jitted caller
+
+
+@jax.jit
+def calls_impure_helper(x):
+    return helper_impure(x)

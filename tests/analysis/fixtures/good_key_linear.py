@@ -1,0 +1,56 @@
+"""Fixture: linear key discipline graftlint must NOT flag."""
+
+import jax
+import jax.numpy as jnp
+
+
+def split_rebind(key):
+    key, sub = jax.random.split(key)  # consume + rebind is linear
+    a = jax.random.uniform(sub, (4,))
+    key, sub2 = jax.random.split(key)  # rebound key: fresh again
+    return a + jax.random.normal(sub2, (4,))
+
+
+def fold_in_loop(key, n):
+    out = jnp.zeros(())
+    for i in range(n):
+        out = out + jax.random.uniform(jax.random.fold_in(key, i))
+    return out
+
+
+def early_return_branches(key, mode):
+    if mode == "a":
+        return jax.random.uniform(key, (2,))  # branch terminates
+    return jax.random.normal(key, (2,))  # so this is the only other use
+
+
+def if_else_once_each(key, flag):
+    if flag:
+        x = jax.random.uniform(key)
+    else:
+        x = jax.random.normal(key)
+    return x
+
+
+def loop_rederive(key, n):
+    out = jnp.zeros(())
+    for _ in range(n):
+        key, sub = jax.random.split(key)  # re-derived every iteration
+        out = out + jax.random.uniform(sub)
+    return out
+
+
+def scan_body_folds_key(key, xs):
+    def body(carry, x):
+        # fold_in with the varying element: derivation, not consumption
+        return carry + jax.random.uniform(jax.random.fold_in(key, x)), x
+
+    out, _ = jax.lax.scan(body, jnp.zeros(()), xs)
+    return out
+
+
+def closure_capture_single_use(key):
+    def helper():
+        return jax.random.uniform(key)  # one consumption, nothing after
+
+    return helper()

@@ -1,0 +1,27 @@
+"""Fixture: correct static_argnames graftlint must NOT flag."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "capacity"))
+def correct(state, cfg, capacity: int):
+    return state[:capacity]
+
+
+@functools.partial(jax.jit, static_argnames=("m", "do_push"))
+def kwonly(plan, x, *, m: int, do_push: bool = True):
+    return x if do_push else x[:m]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def nums_in_range(state, n):
+    return state + n
+
+
+def wrapped(state, mode):
+    return state
+
+
+jitted = jax.jit(wrapped, static_argnames=("mode",))

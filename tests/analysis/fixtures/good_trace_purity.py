@@ -1,0 +1,31 @@
+"""Fixture: purity patterns graftlint must NOT flag."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def static_shape_casts(x):
+    n = int(x.shape[0])  # shape access is trace-time static
+    return x + float(len(x.shape))
+
+
+@functools.partial(jax.jit, static_argnames=("d_max",))
+def static_argname_cast(x, d_max: int):
+    return jnp.minimum(x, float(d_max))  # static arg: a host int at trace
+
+
+def host_bench(run):
+    t0 = time.perf_counter()  # NOT jit-reachable: host timing is fine
+    out = run()
+    wall = time.perf_counter() - t0
+    return float(np.asarray(out).sum()), wall
+
+
+@jax.jit
+def pure_round(x, key):
+    return x + jax.random.uniform(key, x.shape)

@@ -1,0 +1,46 @@
+"""Baseline round-trip + new/old partition semantics (the py3.10-compatible
+minimal TOML subset in analysis/baseline.py)."""
+
+from tpu_gossip.analysis.baseline import load_baseline, split_new, write_baseline
+from tpu_gossip.analysis.registry import Finding
+
+
+def _f(file, rule, msg, line=3):
+    return Finding(file=file, line=line, col=1, rule=rule, message=msg)
+
+
+def test_round_trip(tmp_path):
+    p = tmp_path / "lint_baseline.toml"
+    findings = [
+        _f("a.py", "key-linearity", 'PRNG key "k" consumed twice'),
+        _f("b.py", "trace-purity", 'tricky "quoted" \\ message\nwith newline'),
+    ]
+    write_baseline(p, findings)
+    loaded = load_baseline(p)
+    assert loaded == {f.baseline_key for f in findings}
+
+
+def test_line_numbers_do_not_affect_matching(tmp_path):
+    p = tmp_path / "b.toml"
+    write_baseline(p, [_f("a.py", "r", "m", line=3)])
+    new, old = split_new([_f("a.py", "r", "m", line=99)], load_baseline(p))
+    assert new == [] and len(old) == 1
+
+
+def test_split_new_partition(tmp_path):
+    p = tmp_path / "b.toml"
+    known = _f("a.py", "r", "known")
+    write_baseline(p, [known])
+    fresh = _f("a.py", "r", "fresh")
+    new, old = split_new([known, fresh], load_baseline(p))
+    assert new == [fresh] and old == [known]
+
+
+def test_missing_baseline_is_strict(tmp_path):
+    assert load_baseline(tmp_path / "nope.toml") == set()
+
+
+def test_duplicate_entries_deduped(tmp_path):
+    p = tmp_path / "b.toml"
+    write_baseline(p, [_f("a.py", "r", "m"), _f("a.py", "r", "m", line=9)])
+    assert p.read_text().count("[[finding]]") == 1

@@ -1,7 +1,5 @@
 """Unit coverage for bench.py's helper logic (the driver artifact's math)."""
 
-import numpy as np
-
 import bench
 from tpu_gossip.kernels.pallas_segment import _pad_tiles
 
@@ -50,3 +48,23 @@ def test_bench_liveness_detection_contract():
     assert r["detected"] == r["silent"] == 30
     assert r["detection_round"] == 8
     assert r["within_reference_band"]
+
+
+def test_lint_status_shape():
+    """bench records the graftlint verdict per run (BENCH_DETAIL.json
+    lint_clean field, ISSUE 2 satellite 6) — and the tree is clean."""
+    s = bench._lint_status()
+    assert set(s) == {"lint_clean", "lint"}
+    assert s["lint_clean"] is True, s
+    assert s["lint"]["scope"] == "ast-rules"
+    assert s["lint"]["new_findings"] == 0
+
+
+def test_compact_carries_lint_clean():
+    out = {
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+        "rounds_to_99pct": 1, "wall_seconds": 1.0, "headline_delivery": "x",
+        "lint_clean": True, "configs": {},
+    }
+    compact = bench._compact(out)
+    assert compact["lint_clean"] is True

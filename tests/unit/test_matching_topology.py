@@ -6,7 +6,6 @@ twins where sampled)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpu_gossip.core.matching_topology import (
     MatchingPlan,
@@ -18,7 +17,6 @@ from tpu_gossip.kernels.matching import matching_flood, matching_sampled
 from tpu_gossip.kernels.gossip import flood_all
 from tpu_gossip.kernels.permute import (
     BLOCK_ROWS,
-    apply_pipeline,
     inverse_tables,
     lane_shuffle,
     transpose_pass,

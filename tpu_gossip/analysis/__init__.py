@@ -1,0 +1,45 @@
+"""graftlint: JAX-invariant static analysis for tpu-gossip.
+
+The paper's reproducibility claims rest on invariants that are easy to
+break silently — deterministic PRNG streams (the local↔sharded
+bit-identity contract), one shard_map compat shim (the check_rep→check_vma
+rename broke 23 tests), trace purity in jit-reachable code, and
+stringly-typed ``static_argnames`` that rot on rename. This package
+enforces them BEFORE they land:
+
+- AST rules (registry.py + rules_*.py) over walker.py's module/project
+  index: ``key-linearity``, ``raw-shard-map``, ``trace-purity``,
+  ``static-argnames-drift``.
+- An abstract contract audit (contracts.py): ``jax.eval_shape`` over every
+  public entry point — compile-free shape/dtype verification a CPU-only CI
+  can run in seconds.
+- Pragmas (``# graftlint: disable=<rule> -- reason``) + a checked-in
+  ``lint_baseline.toml`` (baseline.py) so new violations fail CI while
+  deliberate patterns stay documented inline.
+
+Run: ``python -m tpu_gossip.analysis`` or ``tpu-gossip-lint``.
+Docs: docs/static_analysis.md.
+
+Importing this package registers the rules but does NOT import jax —
+the AST passes must run on a tree whose runtime is broken.
+"""
+
+from tpu_gossip.analysis.registry import RULES, Finding, run_rules
+
+# importing the rule modules registers them
+from tpu_gossip.analysis import (  # noqa: F401  (registration imports)
+    rules_prng,
+    rules_purity,
+    rules_shardmap,
+    rules_staticargs,
+)
+from tpu_gossip.analysis.cli import lint_paths, main, run_repo_lint
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "run_rules",
+    "lint_paths",
+    "run_repo_lint",
+    "main",
+]

@@ -1,0 +1,7 @@
+"""``python -m tpu_gossip.analysis`` — the graftlint CLI entry point."""
+
+import sys
+
+from tpu_gossip.analysis.cli import main
+
+sys.exit(main())

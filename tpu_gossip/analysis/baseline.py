@@ -1,0 +1,112 @@
+"""lint_baseline.toml: suppressions for pre-existing findings.
+
+The baseline lets the repo lint clean from day one while NEW violations
+fail CI: a finding whose (file, rule, message) triple appears here is
+reported as "baselined" and doesn't affect the exit code. Entries are
+matched WITHOUT line numbers so edits above a finding don't resurrect it.
+
+The committed baseline should stay empty (or near it): deliberate
+exceptions belong inline as ``# graftlint: disable=<rule> -- <reason>``
+pragmas where the next reader sees them; the baseline is for bulk legacy
+debt during adoption only (ISSUE 2 satellite 1 fixed the tree instead).
+
+This container runs Python 3.10 (no stdlib ``tomllib``), so a minimal
+reader/writer for the restricted subset the baseline uses lives here:
+top-level scalar keys and ``[[finding]]`` array-of-table entries with
+string values. Not a general TOML parser — round-trip is covered by
+tests/analysis/test_baseline.py.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tpu_gossip.analysis.registry import Finding
+
+__all__ = ["load_baseline", "write_baseline", "split_new"]
+
+DEFAULT_BASELINE = "lint_baseline.toml"
+
+
+def _unquote(s: str) -> str:
+    s = s.strip()
+    if len(s) >= 2 and s[0] == s[-1] and s[0] in ("'", '"'):
+        body = s[1:-1]
+        if s[0] == '"':
+            body = (
+                body.replace("\\\\", "\x00")
+                .replace('\\"', '"')
+                .replace("\\n", "\n")
+                .replace("\\t", "\t")
+                .replace("\x00", "\\")
+            )
+        return body
+    return s
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n"
+    ).replace("\t", "\\t") + '"'
+
+
+def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
+    """(file, rule, message) triples from the baseline file; empty set when
+    the file is missing (a fresh checkout without one lints strictly)."""
+    p = Path(path)
+    if not p.is_file():
+        return set()
+    entries: set[tuple[str, str, str]] = set()
+    cur: dict[str, str] | None = None
+
+    def flush():
+        if cur is not None and {"file", "rule", "message"} <= set(cur):
+            entries.add((cur["file"], cur["rule"], cur["message"]))
+
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[finding]]":
+            flush()
+            cur = {}
+        elif "=" in line:
+            key, _, value = line.partition("=")
+            if cur is not None:
+                cur[key.strip()] = _unquote(value)
+    flush()
+    return entries
+
+
+def write_baseline(path: str | Path, findings: list[Finding]) -> None:
+    lines = [
+        "# graftlint baseline — pre-existing findings suppressed from the",
+        "# exit code. Prefer inline `# graftlint: disable=<rule> -- reason`",
+        "# pragmas for deliberate patterns; keep this file empty when the",
+        "# tree is clean. Regenerate: python -m tpu_gossip.analysis "
+        "--write-baseline",
+        "version = 1",
+    ]
+    seen = set()
+    for f in sorted(findings, key=lambda f: f.baseline_key):
+        if f.baseline_key in seen:
+            continue
+        seen.add(f.baseline_key)
+        lines += [
+            "",
+            "[[finding]]",
+            f"file = {_quote(f.file)}",
+            f"rule = {_quote(f.rule)}",
+            f"message = {_quote(f.message)}",
+        ]
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
+def split_new(
+    findings: list[Finding], baseline: set[tuple[str, str, str]]
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined) partition of ``findings``."""
+    new, old = [], []
+    for f in findings:
+        (old if f.baseline_key in baseline else new).append(f)
+    return new, old

@@ -1,0 +1,261 @@
+"""graftlint CLI: ``python -m tpu_gossip.analysis`` / ``tpu-gossip-lint``.
+
+Exit codes: 0 = clean (no findings beyond the baseline), 1 = new findings,
+2 = usage error. ``--fail-on-new`` is the default semantics and accepted
+explicitly for CI-invocation clarity.
+
+Default scope is the package + ``bench.py`` (tests are exempt — they
+deliberately construct pathological inputs); passing explicit paths lints
+just those files and SKIPS the contract audit (fixture linting must not
+import the fixtures' runtime). The contract audit needs a multi-device
+host to verify the mesh engines — run under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the CLI sets it
+when jax is not yet imported and no device-count flag is present).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+from tpu_gossip.analysis.baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    split_new,
+    write_baseline,
+)
+from tpu_gossip.analysis.registry import RULES, Finding, run_rules
+from tpu_gossip.analysis.walker import ModuleInfo, Project
+
+__all__ = ["main", "lint_paths", "repo_root", "run_repo_lint"]
+
+_DEFAULT_SCOPE = ("tpu_gossip", "bench.py")
+_EXCLUDE_PARTS = ("tests", ".git", "__pycache__", ".jax_cache")
+
+
+def repo_root() -> Path:
+    """The repo checkout containing this package (pyproject.toml anchor)."""
+    here = Path(__file__).resolve()
+    for parent in here.parents:
+        if (parent / "pyproject.toml").is_file():
+            return parent
+    return here.parents[2]
+
+
+def _collect_files(root: Path, paths: list[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        pt = Path(p)
+        if not pt.is_absolute():
+            pt = root / pt
+        if pt.is_dir():
+            files.extend(
+                f
+                for f in sorted(pt.rglob("*.py"))
+                if not set(f.relative_to(root).parts) & set(_EXCLUDE_PARTS)
+            )
+        elif pt.is_file():
+            files.append(pt)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return files
+
+
+def lint_paths(
+    paths: list[str],
+    *,
+    root: Path | None = None,
+    rules=None,
+    project_wide: bool = True,
+) -> list[Finding]:
+    """AST rules over ``paths`` (files or directories), sorted findings.
+
+    ``project_wide`` builds the cross-module jit-reachability fixpoint
+    over everything collected (the trace-purity rule needs it); fixture
+    runs on single files can disable it to get module-local semantics.
+    """
+    from tpu_gossip.analysis import rules_purity
+
+    root = repo_root() if root is None else root
+    modules = []
+    for f in _collect_files(root, paths):
+        try:
+            rel = str(f.relative_to(root))
+        except ValueError:
+            rel = str(f)
+        modules.append(ModuleInfo(f, rel))
+    rules_purity.set_project(Project(modules) if project_wide else None)
+    try:
+        findings: list[Finding] = []
+        for m in modules:
+            findings.extend(run_rules(m, only=rules))
+    finally:
+        rules_purity.set_project(None)
+    return sorted(findings, key=lambda f: (f.file, f.line, f.col, f.rule))
+
+
+def run_repo_lint(with_contracts: bool = False) -> dict:
+    """Programmatic entry (bench.py's lint_clean field): returns
+    ``{"clean": bool, "new": [...], "baselined": n}`` over the default
+    scope + baseline."""
+    root = repo_root()
+    findings = lint_paths(list(_DEFAULT_SCOPE), root=root)
+    if with_contracts:
+        from tpu_gossip.analysis.contracts import audit_contracts
+
+        findings = findings + audit_contracts()
+    baseline = load_baseline(root / DEFAULT_BASELINE)
+    new, old = split_new(findings, baseline)
+    return {
+        "clean": not new,
+        "new": [f.to_dict() for f in new],
+        "baselined": len(old),
+    }
+
+
+def _ensure_multi_device_env() -> None:
+    """Give the contract audit its 8-CPU mesh: XLA reads XLA_FLAGS at
+    backend CREATION, which is lazy — so setting it here works even though
+    jax was imported with the package, as long as no computation ran yet
+    (same trick as tests/conftest.py). A user-provided device-count flag
+    is respected."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-gossip-lint",
+        description="graftlint: JAX-invariant static analysis for tpu-gossip "
+        "(key linearity, shard_map hygiene, trace purity, static_argnames "
+        "drift) plus an eval_shape contract audit.",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: tpu_gossip/ bench.py + "
+        "contract audit)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="json emits {findings, new, baselined, clean} for tooling diffs",
+    )
+    ap.add_argument(
+        "--fail-on-new", action="store_true",
+        help="exit 1 when findings beyond the baseline exist (the default "
+        "semantics; accepted explicitly for CI invocations)",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule subset (default: all AST rules)",
+    )
+    ap.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the eval_shape contract audit (AST rules only)",
+    )
+    ap.add_argument(
+        "--contracts-only", action="store_true",
+        help="run only the contract audit",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(rid)
+        return 0
+
+    root = repo_root()
+    only = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    if only:
+        unknown = set(only) - set(RULES)
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(known: {', '.join(sorted(RULES))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    explicit_paths = bool(args.paths)
+    run_contracts = (
+        not args.no_contracts and not explicit_paths and only is None
+    ) or args.contracts_only
+    t0 = time.perf_counter()
+    findings: list[Finding] = []
+    if not args.contracts_only:
+        try:
+            findings = lint_paths(
+                args.paths or list(_DEFAULT_SCOPE), root=root, rules=only
+            )
+        except (FileNotFoundError, SyntaxError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    if run_contracts:
+        _ensure_multi_device_env()
+        from tpu_gossip.analysis.contracts import audit_contracts
+
+        findings = findings + audit_contracts()
+
+    baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+    baseline = load_baseline(baseline_path)
+    new, old = split_new(findings, baseline)
+    elapsed = time.perf_counter() - t0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "clean": not new,
+                    "new": [f.to_dict() for f in new],
+                    "baselined": [f.to_dict() for f in old],
+                    "rules": sorted(RULES),
+                    "contract_audit": run_contracts,
+                    "elapsed_seconds": round(elapsed, 2),
+                },
+                indent=1,
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        tail = (
+            f"graftlint: {len(new)} new finding(s), {len(old)} baselined, "
+            f"{len(RULES)} rules"
+            + (", contract audit on" if run_contracts else "")
+            + f", {elapsed:.1f}s"
+        )
+        print(tail, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

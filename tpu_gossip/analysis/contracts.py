@@ -1,0 +1,484 @@
+"""Abstract contract audit: jax.eval_shape over every public entry point.
+
+The AST rules catch discipline violations; this pass catches SHAPE and
+DTYPE drift — the class of bug a CPU-only CI cannot execute its way into
+(10M-scale kernels, mesh collectives) but CAN abstractly evaluate in
+milliseconds. Every public entry point is traced with ``jax.eval_shape``
+over a small parameter grid and its declared contract asserted:
+
+- **round engines** (``gossip_round``, ``simulate``,
+  ``run_until_coverage``, ``gossip_round_dist`` over both the bucketed-CSR
+  and matching mesh engines): the output ``SwarmState`` must carry
+  EXACTLY the input's per-leaf shapes/dtypes — the state pytree is a
+  fixed-point of the round map (anything else breaks ``lax.scan`` /
+  ``while_loop`` carries and checkpoint resume) — and ``RoundStats``
+  fields must be scalars of their declared dtypes (stacked to
+  ``(num_rounds,)`` under ``simulate``).
+- **builders** (``matching_powerlaw_graph`` and its sharded twin,
+  ``device_powerlaw_graph``): CSR invariants (row_ptr ``(rows+1,)`` int32
+  and monotone, col_idx int32, exists bool of row count) checked on
+  concretely-built TINY graphs (n of a few hundred — the one compiled
+  step, seconds on CPU), because builder output feeds every other
+  contract.
+- **Pallas wrapper kernels** (``matching_flood``/``matching_sampled``,
+  ``segment_or``/``segment_sampled``, ``apply_pipeline`` via
+  ``MatchingPlan.partner``): delivery shape ``(n_state, m)`` bool +
+  scalar int32 billing, abstractly (``interpret`` mode semantics — the
+  kernels carry abstract-eval rules, nothing executes).
+
+Checks resolve their targets through the owning MODULE at call time
+(``engine.gossip_round``, not a captured reference) so tests can
+monkeypatch a deliberate contract break and assert this audit reports it
+(tests/analysis/test_contracts.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict
+
+from tpu_gossip.analysis.registry import Finding
+
+__all__ = ["AUDIT_CHECKS", "audit_contracts", "audit_check"]
+
+AUDIT_CHECKS: Dict[str, Callable[[], list]] = {}
+
+_N_MATCH = 256  # tiny matching build (compile cost: seconds, CPU)
+_N_DEV = 512  # tiny device-CSR build
+_MSG_SLOTS = (1, 16)  # one word group / multi-slot packed group
+_MODES = ("push", "push_pull", "flood")
+
+
+def audit_check(name: str):
+    def deco(fn):
+        AUDIT_CHECKS[name] = fn
+        fn.check_name = name
+        return fn
+
+    return deco
+
+
+def _spec_tree(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: (tuple(leaf.shape), str(leaf.dtype)), tree
+    )
+
+
+def _diff_specs(name: str, got, want, problems: list) -> None:
+    import jax
+
+    gl, gt = jax.tree_util.tree_flatten(got)
+    wl, wt = jax.tree_util.tree_flatten(want)
+    if gt != wt:
+        problems.append(f"{name}: pytree structure changed: {gt} != {wt}")
+        return
+    for i, (g, w) in enumerate(zip(gl, wl)):
+        if g != w:
+            problems.append(
+                f"{name}: leaf {i} spec drift: got {g}, declared {w}"
+            )
+
+
+@functools.lru_cache(maxsize=None)
+def _ctx():
+    """Tiny concrete graphs/plans/states shared by all checks (built once)."""
+    import jax
+    import numpy as np
+
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.kernels.pallas_segment import build_staircase_plan
+
+    dg = device_powerlaw_graph(_N_DEV, gamma=2.5, key=jax.random.key(0))
+    mg, mplan = matching_powerlaw_graph(
+        _N_MATCH, gamma=2.5, fanout=1, key=jax.random.key(0), export_csr=True
+    )
+    splan = build_staircase_plan(
+        np.asarray(dg.row_ptr), np.asarray(dg.col_idx), fanout=1
+    )
+
+    def state_for(graph, m: int, **cfg_kw):
+        cfg = SwarmConfig(
+            n_peers=graph.n_pad, msg_slots=m, fanout=1, **cfg_kw
+        )
+        st = init_swarm(
+            graph.as_padded_graph(), cfg, origins=[0], exists=graph.exists,
+            key=jax.random.key(0),
+        )
+        return st, cfg
+
+    return {
+        "dg": dg, "mg": mg, "mplan": mplan, "splan": splan,
+        "state_for": state_for,
+    }
+
+
+def _stats_contract(stats, problems: list, leading=()) -> None:
+    import jax.numpy as jnp
+
+    declared = {
+        "coverage": jnp.float32,
+        "msgs_sent": jnp.int32,
+        "n_infected": jnp.int32,
+        "n_alive": jnp.int32,
+        "n_declared_dead": jnp.int32,
+    }
+    for field, dt in declared.items():
+        leaf = getattr(stats, field, None)
+        if leaf is None:
+            problems.append(f"RoundStats lost field {field!r}")
+            continue
+        if tuple(leaf.shape) != tuple(leading):
+            problems.append(
+                f"RoundStats.{field}: shape {tuple(leaf.shape)} != declared "
+                f"{tuple(leading)}"
+            )
+        if leaf.dtype != dt:
+            problems.append(
+                f"RoundStats.{field}: dtype {leaf.dtype} != declared {dt}"
+            )
+
+
+# --------------------------------------------------------------- builders
+@audit_check("builder_csr")
+def _check_builders() -> list:
+    import numpy as np
+
+    problems: list[str] = []
+    ctx = _ctx()
+    for name, g, rows in (
+        ("device_powerlaw_graph", ctx["dg"], _N_DEV + 1),
+        ("matching_powerlaw_graph", ctx["mg"], _N_MATCH + 1),
+    ):
+        rp = np.asarray(g.row_ptr)
+        if rp.shape != (rows + 1,) or rp.dtype != np.int32:
+            problems.append(
+                f"{name}: row_ptr {rp.shape}/{rp.dtype} != declared "
+                f"({rows + 1},)/int32"
+            )
+        if np.any(np.diff(rp) < 0):
+            problems.append(f"{name}: row_ptr not monotone")
+        ci = np.asarray(g.col_idx)
+        if ci.ndim != 1 or ci.dtype != np.int32:
+            problems.append(
+                f"{name}: col_idx {ci.shape}/{ci.dtype} != declared 1-D int32"
+            )
+        if rp[-1] > ci.shape[0]:
+            problems.append(
+                f"{name}: row_ptr[-1]={rp[-1]} exceeds col_idx length "
+                f"{ci.shape[0]}"
+            )
+        ex = np.asarray(g.exists)
+        if ex.shape != (rows,) or ex.dtype != np.bool_:
+            problems.append(
+                f"{name}: exists {ex.shape}/{ex.dtype} != declared "
+                f"({rows},)/bool"
+            )
+    plan = ctx["mplan"]
+    if tuple(plan.valid.shape) != (plan.rows, 128):
+        problems.append(
+            f"matching plan: valid {tuple(plan.valid.shape)} != "
+            f"({plan.rows}, 128)"
+        )
+    if plan.deg_other is None or tuple(plan.deg_other.shape) != (
+        plan.rows, 128,
+    ):
+        problems.append("matching plan: deg_other missing or mis-shaped")
+    if plan.deg_real is None or tuple(plan.deg_real.shape) != (plan.n,):
+        problems.append("matching plan: deg_real missing or mis-shaped")
+    return problems
+
+
+@audit_check("builder_sharded")
+def _check_sharded_builder() -> list:
+    import jax
+    import numpy as np
+
+    from tpu_gossip.core import matching_topology as mt
+
+    problems: list[str] = []
+    shards = 4  # any divisor of 128 exercises the layout algebra
+    g, plan = mt.matching_powerlaw_graph_sharded(
+        _N_MATCH, shards, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    if plan.mesh_shards != shards:
+        problems.append(
+            f"sharded plan: mesh_shards {plan.mesh_shards} != {shards}"
+        )
+    if plan.rows != plan.per_rows * shards:
+        problems.append(
+            f"sharded plan: rows {plan.rows} != per_rows*shards "
+            f"{plan.per_rows * shards}"
+        )
+    if plan.n != plan.n_blk * shards:
+        problems.append(
+            f"sharded plan: n {plan.n} != n_blk*shards {plan.n_blk * shards}"
+        )
+    rp = np.asarray(g.row_ptr)
+    if rp.shape != (plan.n + 1,):
+        problems.append(
+            f"sharded CSR: row_ptr {rp.shape} != declared ({plan.n + 1},) "
+            "(sentinel reuses the last pad row, no extra row)"
+        )
+    return problems
+
+
+# ----------------------------------------------------------- round engines
+@audit_check("gossip_round_local")
+def _check_gossip_round() -> list:
+    import jax
+
+    from tpu_gossip.sim import engine
+
+    problems: list[str] = []
+    ctx = _ctx()
+    grids = []
+    for m in _MSG_SLOTS:
+        for mode in _MODES:
+            grids.append((ctx["dg"], None, m, mode, "xla", {}))
+            grids.append((ctx["dg"], ctx["splan"], m, mode, "pallas", {}))
+            grids.append((ctx["mg"], ctx["mplan"], m, mode, "matching", {}))
+    # churn + SIR shapes ride the same fixed-point contract
+    churn = dict(
+        churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+    )
+    grids.append((ctx["dg"], None, 16, "push_pull", "xla-churn", churn))
+    grids.append(
+        (ctx["dg"], None, 16, "push_pull", "xla-sir",
+         dict(sir_recover_rounds=8))
+    )
+    grids.append(
+        (ctx["dg"], None, 16, "push_pull", "xla-churn-compact",
+         {**churn, "rewire_compact_cap": 64})
+    )
+    for graph, plan, m, mode, label, extra in grids:
+        st, cfg = ctx["state_for"](graph, m, mode=mode, **extra)
+        name = f"gossip_round[{label},{mode},m={m}]"
+        try:
+            out_st, out_stats = jax.eval_shape(
+                lambda s: engine.gossip_round(s, cfg, plan), st
+            )
+        except Exception as e:  # noqa: BLE001 — any trace failure is a finding
+            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
+            continue
+        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
+        _stats_contract(out_stats, problems)
+    return problems
+
+
+@audit_check("simulate_and_coverage")
+def _check_simulate() -> list:
+    import jax
+
+    from tpu_gossip.sim import engine
+
+    problems: list[str] = []
+    ctx = _ctx()
+    st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
+    rounds = 3
+    try:
+        fin, stats = jax.eval_shape(
+            lambda s: engine.simulate(s, cfg, rounds), st
+        )
+        _diff_specs("simulate", _spec_tree(fin), _spec_tree(st), problems)
+        _stats_contract(stats, problems, leading=(rounds,))
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"simulate: abstract eval failed: {e!r:.200}")
+    try:
+        fin = jax.eval_shape(
+            lambda s: engine.run_until_coverage(s, cfg, 0.99, 10), st
+        )
+        _diff_specs(
+            "run_until_coverage", _spec_tree(fin), _spec_tree(st), problems
+        )
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"run_until_coverage: abstract eval failed: {e!r:.200}")
+    return problems
+
+
+@audit_check("pallas_wrappers")
+def _check_kernels() -> list:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_gossip.kernels import matching as km
+    from tpu_gossip.kernels import pallas_segment as ps
+
+    problems: list[str] = []
+    ctx = _ctx()
+    mplan, splan = ctx["mplan"], ctx["splan"]
+    n_match, n_dev = _N_MATCH + 1, _N_DEV + 1
+    key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+    for m in _MSG_SLOTS:
+        tx_m = jax.ShapeDtypeStruct((n_match, m), jnp.bool_)
+        tx_s = jax.ShapeDtypeStruct((n_dev, m), jnp.bool_)
+        rec_m = jax.ShapeDtypeStruct((n_match,), jnp.bool_)
+        rec_s = jax.ShapeDtypeStruct((n_dev,), jnp.bool_)
+        cases = [
+            (
+                f"matching_flood[m={m}]",
+                lambda t=tx_m, mm=m: km.matching_flood(
+                    mplan, t, mm, interpret=True
+                ),
+                (n_match, m),
+                None,
+            ),
+            (
+                f"matching_sampled[m={m}]",
+                lambda t=tx_m, r=rec_m, k=key, mm=m: km.matching_sampled(
+                    mplan, t, None, mm, k, receptive_rows=r,
+                    do_push=True, do_pull=True, interpret=True,
+                ),
+                (n_match, m),
+                "billed",
+            ),
+            (
+                f"segment_or[m={m}]",
+                lambda t=tx_s, mm=m: ps.segment_or(
+                    splan, t, mm, interpret=True
+                ),
+                (n_dev, m),
+                None,
+            ),
+            (
+                f"segment_sampled[m={m}]",
+                lambda t=tx_s, r=rec_s, k=key, mm=m: ps.segment_sampled(
+                    splan, t, None, mm, k, receptive_rows=r,
+                    do_push=True, do_pull=True, interpret=True,
+                ),
+                (n_dev, m),
+                "billed",
+            ),
+        ]
+        for name, thunk, want_shape, billed in cases:
+            try:
+                out = jax.eval_shape(thunk)
+            except Exception as e:  # noqa: BLE001
+                problems.append(f"{name}: abstract eval failed: {e!r:.200}")
+                continue
+            inc, msgs = out if billed else (out, None)
+            if tuple(inc.shape) != want_shape or inc.dtype != jnp.bool_:
+                problems.append(
+                    f"{name}: incoming {tuple(inc.shape)}/{inc.dtype} != "
+                    f"declared {want_shape}/bool"
+                )
+            if billed and (tuple(msgs.shape) != () or msgs.dtype != jnp.int32):
+                problems.append(
+                    f"{name}: msgs {tuple(msgs.shape)}/{msgs.dtype} != "
+                    "declared scalar int32"
+                )
+    # the pairing pipeline preserves slot-array spec (partner is a bijection)
+    x = jax.ShapeDtypeStruct((mplan.rows, 128), jnp.int32)
+    try:
+        out = jax.eval_shape(lambda: mplan.partner(x, interpret=True))
+        if (tuple(out.shape), out.dtype) != ((mplan.rows, 128), jnp.int32):
+            problems.append(
+                f"MatchingPlan.partner: {tuple(out.shape)}/{out.dtype} != "
+                f"declared ({mplan.rows}, 128)/int32"
+            )
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"MatchingPlan.partner: abstract eval failed: {e!r:.200}")
+    return problems
+
+
+@audit_check("gossip_round_dist")
+def _check_dist() -> list:
+    import jax
+
+    from tpu_gossip import dist as dist_pkg
+    from tpu_gossip.core import matching_topology as mt
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.dist import mesh as mesh_mod
+
+    problems: list[str] = []
+    mesh = dist_pkg.make_mesh()
+    if 128 % mesh.size:
+        return [
+            f"mesh size {mesh.size} does not divide 128 — matching dist "
+            "contract unverifiable on this host (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        ]
+    # matching mesh engine: the sharded plan IS the delivery engine
+    g, plan = mt.matching_powerlaw_graph_sharded(
+        _N_MATCH, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull")
+    st = init_swarm(
+        g.as_padded_graph(), cfg, origins=[0], exists=g.exists,
+        key=jax.random.key(0),
+    )
+    try:
+        out_st, out_stats = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(s, cfg, plan, mesh), st
+        )
+        _diff_specs(
+            "gossip_round_dist[matching]",
+            _spec_tree(out_st), _spec_tree(st), problems,
+        )
+        _stats_contract(out_stats, problems)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[matching]: abstract eval failed: {e!r:.200}"
+        )
+    # bucketed-CSR engine over a partitioned host graph
+    import numpy as np
+
+    from tpu_gossip.core.topology import (
+        build_csr, configuration_model, powerlaw_degree_sequence,
+    )
+
+    rng = np.random.default_rng(0)
+    graph = build_csr(
+        _N_DEV,
+        configuration_model(
+            powerlaw_degree_sequence(_N_DEV, gamma=2.5, rng=rng), rng=rng
+        ),
+    )
+    sg, relabeled, position = mesh_mod.partition_graph(graph, mesh.size, seed=0)
+    cfg2 = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
+    st2 = mesh_mod.init_sharded_swarm(sg, relabeled, position, cfg2, origins=[0])
+    try:
+        out_st, out_stats = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(s, cfg2, sg, mesh), st2
+        )
+        _diff_specs(
+            "gossip_round_dist[bucketed]",
+            _spec_tree(out_st), _spec_tree(st2), problems,
+        )
+        _stats_contract(out_stats, problems)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[bucketed]: abstract eval failed: {e!r:.200}"
+        )
+    return problems
+
+
+def audit_contracts(names=None) -> list[Finding]:
+    """Run the contract checks; each problem line becomes one Finding."""
+    findings: list[Finding] = []
+    for name, check in AUDIT_CHECKS.items():
+        if names is not None and name not in names:
+            continue
+        try:
+            problems = check()
+        except Exception as e:  # noqa: BLE001 — a crashed check must FAIL CI
+            problems = [f"check crashed: {e!r:.300}"]
+        for p in problems:
+            findings.append(
+                Finding(
+                    file=f"<contract:{name}>",
+                    line=0,
+                    col=0,
+                    rule="contract-audit",
+                    message=p,
+                    hint="declared contracts live in "
+                    "tpu_gossip/analysis/contracts.py — fix the entry point "
+                    "or update the declaration WITH the behavior change",
+                )
+            )
+    return findings

@@ -1,0 +1,123 @@
+"""Finding record + rule registry for graftlint.
+
+A *rule* is a callable ``rule(module: ModuleInfo) -> Iterable[Finding]``
+registered under a stable kebab-case id. Rules are pure AST passes — no
+imports of the analyzed code, no execution — so the linter can run on a
+broken tree (that is the point: it must catch the breakage). The
+eval_shape contract audit (contracts.py) is the one deliberately dynamic
+pass and lives outside this registry.
+
+Suppression layers, strongest first:
+
+1. ``# graftlint: disable=<rule>[,<rule>] -- <reason>`` pragma on the
+   finding's line (walker.py parses these; a pragma WITHOUT a reason is
+   itself a finding — deliberate exceptions must say why).
+2. ``lint_baseline.toml`` entries (baseline.py) keyed on
+   (file, rule, message) — line numbers drift, messages are stable — so
+   pre-existing debt doesn't fail CI while NEW violations do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable
+
+__all__ = ["Finding", "RULES", "rule", "run_rules"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: where, which rule, what, and how to fix it."""
+
+    file: str  # repo-relative posix path
+    line: int  # 1-based; 0 = whole-file / non-positional (contract audit)
+    col: int
+    rule: str
+    message: str
+    hint: str = ""
+
+    @property
+    def baseline_key(self) -> tuple[str, str, str]:
+        """Identity for baseline matching: line/col excluded so unrelated
+        edits above a finding don't resurrect it."""
+        return (self.file, self.rule, self.message)
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}:{self.col}" if self.line else self.file
+        out = f"{loc}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+RULES: Dict[str, Callable] = {}
+
+
+def rule(rule_id: str):
+    """Register a rule under ``rule_id`` (decorator)."""
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = fn
+        fn.rule_id = rule_id
+        return fn
+
+    return deco
+
+
+def run_rules(module, only: Iterable[str] | None = None) -> list[Finding]:
+    """All registered rules over one module, pragma suppression applied.
+
+    A pragma suppresses findings ON ITS LINE for the named rules ("*" for
+    all); pragmas missing a reason surface as ``pragma-needs-reason``
+    findings so silent suppressions can't accumulate.
+    """
+    findings: list[Finding] = []
+    ids = tuple(only) if only is not None else tuple(RULES)
+    for rid in ids:
+        for f in RULES[rid](module):
+            prag = module.pragmas.get(f.line)
+            if prag is not None and ("*" in prag.rules or f.rule in prag.rules):
+                continue
+            findings.append(f)
+    seen_pragmas: set[int] = set()
+    for line, prag in sorted(module.pragmas.items()):
+        if id(prag) in seen_pragmas:
+            continue  # comment-line pragma also registered on the next code line
+        seen_pragmas.add(id(prag))
+        if not prag.reason:
+            findings.append(
+                Finding(
+                    file=module.rel,
+                    line=line,
+                    col=1,
+                    rule="pragma-needs-reason",
+                    message=(
+                        "graftlint pragma suppresses "
+                        f"{','.join(sorted(prag.rules))} without a reason"
+                    ),
+                    hint="write `# graftlint: disable=<rule> -- <why this "
+                    "is deliberate>`",
+                )
+            )
+        unknown = prag.rules - set(RULES) - {"*", "pragma-needs-reason"}
+        if unknown:
+            findings.append(
+                Finding(
+                    file=module.rel,
+                    line=line,
+                    col=1,
+                    rule="pragma-unknown-rule",
+                    message=(
+                        "graftlint pragma names unknown rule(s): "
+                        f"{','.join(sorted(unknown))}"
+                    ),
+                    hint=f"known rules: {', '.join(sorted(RULES))}",
+                )
+            )
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,210 @@
+"""trace-purity: no host-sync / impure calls inside jit-reachable code.
+
+The invariant: every function a ``jax.jit`` trace can reach must be a pure
+array program. ``float()``/``int()``/``.item()`` on a traced value force a
+device->host sync (a ConcretizationTypeError at best, a silent per-round
+host round-trip under weaker tracers); ``np.asarray`` materializes a traced
+value on host; ``time.*`` and stdlib/numpy ``random.*`` bake a host value
+into the trace at compile time — the classic "why is my churn identical
+every round" bug. Reachability is the project-wide fixpoint from
+walker.Project (seeds: jit-decorated functions; propagation: resolved
+calls, nested defs, function-valued arguments).
+
+Static-cast exemption: ``int(...)``/``float(...)`` over trace-time
+constants is idiomatic and allowed — arguments mentioning ``.shape``,
+``.ndim``, ``.size``, ``.dtype``, ``len(...)``, literals, or plain
+arithmetic thereof stay clean (``sim/engine.py`` sizes capacity tables
+this way).
+
+File allowlist: ``core/topology.py`` and ``core/matching_topology.py``
+keep deliberate host-side build paths (numpy graph planning that runs once
+at setup, never per round); their non-jit-decorated functions are exempt
+even when the call graph over-approximates them as reachable. Their
+jit-decorated builders (``_build_plan``) are NOT exempt — those trace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_gossip.analysis.registry import Finding, rule
+from tpu_gossip.analysis.walker import ModuleInfo, Project
+
+__all__ = ["check_trace_purity", "set_project"]
+
+# host-side-by-design modules: non-jitted functions exempt (see docstring)
+_ALLOW_HOST_FILES = (
+    "tpu_gossip/core/topology.py",
+    "tpu_gossip/core/matching_topology.py",
+)
+
+# dotted-prefix -> why it's impure under trace
+_BAD_PREFIXES = (
+    ("time.", "wall-clock read baked into the trace at compile time"),
+    ("random.", "stdlib RNG draws a host value once at trace time"),
+    ("numpy.random.", "numpy RNG draws a host value once at trace time"),
+)
+_BAD_EXACT = {
+    "numpy.asarray": "materializes a traced value on host",
+    "numpy.array": "materializes a traced value on host",
+}
+_HOST_CASTS = {"float", "int", "bool"}
+
+# the active project, injected by the CLI so the rule sees the global
+# reachability fixpoint (rules are per-module callables by contract)
+_PROJECT: Project | None = None
+
+
+def set_project(project: Project | None) -> None:
+    global _PROJECT
+    _PROJECT = project
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when an int()/float() argument is clearly trace-time static."""
+    if isinstance(node, ast.Constant):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+            "shape", "ndim", "size", "dtype", "n", "rows", "n_peers",
+        ):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and (
+            sub.func.id == "len"
+        ):
+            return True
+    return False
+
+
+def _walk_own(fn: ast.AST):
+    """Walk a function's own body, stopping at nested def boundaries
+    (nested functions are visited as their own FuncInfo)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _static_param_names(module: ModuleInfo, fn: ast.AST) -> set[str]:
+    """Parameters a jit decorator declares static — host values at trace
+    time, so int()/float() over them is NOT a sync (device_topology._build
+    casts its static d_max this way)."""
+    from tpu_gossip.analysis.rules_staticargs import (
+        _jit_call_kwargs, _literal_names,
+    )
+
+    names: set[str] = set()
+    for dec in getattr(fn, "decorator_list", ()):
+        kwargs = _jit_call_kwargs(module, dec)
+        for kw in kwargs or ():
+            if kw.arg == "static_argnames":
+                names.update(n for n, _ in (_literal_names(kw.value) or ()))
+    return names
+
+
+def _check_function(module: ModuleInfo, fn: ast.AST):
+    static_params = _static_param_names(module, fn)
+    for node in _walk_own(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = module.dotted(node.func)
+        fname = getattr(fn, "name", "<lambda>")
+        if dotted is not None:
+            why = _BAD_EXACT.get(dotted)
+            if why is None:
+                for prefix, reason in _BAD_PREFIXES:
+                    if dotted.startswith(prefix):
+                        why = reason
+                        break
+            if why is not None:
+                yield Finding(
+                    file=module.rel,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    rule="trace-purity",
+                    message=(
+                        f"{dotted}(...) inside jit-reachable {fname}: {why}"
+                    ),
+                    hint="hoist to the host-side caller, or thread the value "
+                    "in as an argument / jax.random key",
+                )
+                continue
+            if (
+                dotted in _HOST_CASTS
+                and node.args
+                and not _is_static_expr(node.args[0])
+                and not (
+                    isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in static_params
+                )
+            ):
+                yield Finding(
+                    file=module.rel,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    rule="trace-purity",
+                    message=(
+                        f"{dotted}() on a possibly-traced value inside "
+                        f"jit-reachable {fname} forces a host sync"
+                    ),
+                    hint="keep it an array (jnp.*), or compute from .shape/"
+                    "len() if it is meant to be static",
+                )
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            # flagged regardless of the base expression: no module in this
+            # codebase exposes an .item() that isn't a device scalar fetch,
+            # and attribute chains (state.coverage.item()) are the COMMON
+            # form of the bug
+            yield Finding(
+                file=module.rel,
+                line=node.lineno,
+                col=node.col_offset + 1,
+                rule="trace-purity",
+                message=(
+                    f".item() inside jit-reachable "
+                    f"{getattr(fn, 'name', '<lambda>')} forces a "
+                    "device->host sync"
+                ),
+                hint="keep the value on device; fetch scalars only "
+                "outside the jit boundary",
+            )
+
+
+@rule("trace-purity")
+def check_trace_purity(module: ModuleInfo):
+    if _PROJECT is None:
+        # standalone single-module mode (fixtures): treat jit-decorated
+        # functions and their nested defs as the reachable set
+        reachable = set()
+        by_id = {id(fi): fi for fi in module.functions}
+        children = {}
+        for fi in module.functions:
+            if fi.parent is not None:
+                children.setdefault(id(fi.parent), []).append(fi)
+        work = [fi for fi in module.functions if fi.jit_decorated]
+        while work:
+            fi = work.pop()
+            if id(fi) in reachable:
+                continue
+            reachable.add(id(fi))
+            work.extend(children.get(id(fi), ()))
+            for target in fi.calls | fi.fn_args:
+                if target[0] == module.module_dotted:
+                    for other in module.functions:
+                        if other.qualname == target[1]:
+                            work.append(other)
+        reach_ids = reachable
+    else:
+        reach_ids = _PROJECT.jit_reachable()
+    host_allowed = module.rel in _ALLOW_HOST_FILES
+    for fi in module.functions:
+        if id(fi) not in reach_ids:
+            continue
+        if host_allowed and not fi.jit_decorated and (
+            fi.parent is None or not fi.parent.jit_decorated
+        ):
+            continue
+        yield from _check_function(module, fi.node)

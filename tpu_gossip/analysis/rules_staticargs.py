@@ -1,0 +1,140 @@
+"""static-argnames-drift: jit static argument names must exist.
+
+The invariant: ``jax.jit(..., static_argnames=("cfg", "capacity"))`` is
+stringly-typed — rename the parameter and jax (0.4.x) silently ignores the
+stale name, so the argument becomes TRACED: dict/dataclass configs raise
+deep inside tracing, and hashable ones silently recompile per call or bury
+a tracer where a Python int was expected. There are 10+ such entry points
+across ``kernels/``, ``sim/engine.py``, ``dist/`` and
+``core/*_topology.py``; this rule pins every name to an actual parameter
+of the wrapped function.
+
+Covered decorator/call shapes (literal names only — computed name tuples
+are skipped as unprovable):
+
+- ``@functools.partial(jax.jit, static_argnames=...)`` (the repo idiom)
+- ``@jax.jit`` with keyword arguments
+- ``f = jax.jit(g, static_argnames=...)`` at module level, ``g`` local
+
+``static_argnums`` literals are range-checked against the positional
+parameter count as the same class of drift.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_gossip.analysis.registry import Finding, rule
+from tpu_gossip.analysis.walker import ModuleInfo
+
+__all__ = ["check_static_argnames"]
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _literal_names(node: ast.AST) -> list[tuple[str, ast.AST]] | None:
+    """static_argnames value -> [(name, node)] if fully literal, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append((el.value, el))
+            else:
+                return None
+        return out
+    return None
+
+
+def _jit_call_kwargs(module: ModuleInfo, dec: ast.AST):
+    """Keywords of a jit decorator/call, or None when it isn't one."""
+    if not isinstance(dec, ast.Call):
+        return None
+    dotted = module.dotted(dec.func)
+    if dotted in ("jax.jit", "jax.pmap"):
+        return dec.keywords
+    if dotted in ("functools.partial", "partial") and any(
+        module.dotted(a) in ("jax.jit", "jax.pmap") for a in dec.args
+    ):
+        return dec.keywords
+    return None
+
+
+def _check(module: ModuleInfo, kwargs, fn: ast.AST, fname: str):
+    params = _param_names(fn)
+    n_positional = len(fn.args.posonlyargs) + len(fn.args.args)
+    for kw in kwargs:
+        if kw.arg == "static_argnames":
+            names = _literal_names(kw.value)
+            if names is None:
+                continue
+            for name, node in names:
+                if name not in params:
+                    yield Finding(
+                        file=module.rel,
+                        line=node.lineno,
+                        col=node.col_offset + 1,
+                        rule="static-argnames-drift",
+                        message=(
+                            f"static_argnames entry {name!r} is not a "
+                            f"parameter of {fname} (has: "
+                            f"{', '.join(params)})"
+                        ),
+                        hint="rename the entry with the parameter — a stale "
+                        "name silently demotes the argument to traced",
+                    )
+        elif kw.arg == "static_argnums":
+            nums = []
+            v = kw.value
+            els = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in els:
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    nums.append((el.value, el))
+            for num, node in nums:
+                if num >= n_positional or num < -n_positional:
+                    yield Finding(
+                        file=module.rel,
+                        line=node.lineno,
+                        col=node.col_offset + 1,
+                        rule="static-argnames-drift",
+                        message=(
+                            f"static_argnums {num} out of range for {fname} "
+                            f"({n_positional} positional parameters)"
+                        ),
+                        hint="drop or renumber the stale index",
+                    )
+
+
+@rule("static-argnames-drift")
+def check_static_argnames(module: ModuleInfo):
+    # decorated functions (nested included — FuncInfo carries every def)
+    for fi in module.functions:
+        for dec in fi.node.decorator_list:
+            kwargs = _jit_call_kwargs(module, dec)
+            if kwargs:
+                yield from _check(module, kwargs, fi.node, fi.qualname)
+    # assignment form: f = jax.jit(g, static_argnames=...)
+    top_level = {
+        fi.qualname: fi.node for fi in module.functions if "." not in fi.qualname
+    }
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kwargs = _jit_call_kwargs(module, node)
+        if not kwargs or not node.args:
+            continue
+        wrapped = node.args[0]
+        if isinstance(wrapped, ast.Name) and wrapped.id in top_level:
+            yield from _check(
+                module, kwargs, top_level[wrapped.id], wrapped.id
+            )

@@ -1,0 +1,285 @@
+"""Module loading, pragma parsing, import resolution, jit-reachability.
+
+Everything the rules share lives here, computed once per file:
+
+- :class:`ModuleInfo` — source text, AST, pragma map, import alias maps,
+  and every function (nested included) as a :class:`FuncInfo`.
+- :func:`ModuleInfo.dotted` — resolve an expression to its absolute
+  dotted path through the module's imports (``jnp.zeros`` →
+  ``jax.numpy.zeros``; ``random.split`` after ``from jax import random``
+  → ``jax.random.split``), so rules never string-match local aliases.
+- :func:`Project.jit_reachable` — the project-wide set of functions a
+  ``jax.jit`` trace can reach, computed as a fixpoint over a resolved
+  call graph. Seeds are jit-decorated functions; reachability propagates
+  to (a) resolved callees, (b) functions nested inside a reachable
+  function (``lax.scan``/``while_loop`` bodies, ``shard_map`` closures),
+  and (c) module-local functions passed by name as call arguments
+  (Pallas kernel bodies handed to ``pallas_call``). Method calls through
+  objects (``plan.partner(...)``) are not resolvable statically and are
+  documented as out of scope (docs/static_analysis.md).
+
+Pragma grammar (line-scoped)::
+
+    # graftlint: disable=<rule>[,<rule>...] [--] <reason>
+
+A reason is REQUIRED — registry.run_rules turns reason-less pragmas into
+``pragma-needs-reason`` findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+__all__ = ["Pragma", "FuncInfo", "ModuleInfo", "Project"]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_*,\-]+)[ \t]*(?:--)?[ \t]*(.*)$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    rules: frozenset
+    reason: str
+    line: int
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function (or nested function) definition."""
+
+    qualname: str  # dotted within the module, e.g. "simulate.body"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    parent: "FuncInfo | None"
+    jit_decorated: bool = False
+    # resolved call targets: set of (module_dotted, func_name)
+    calls: set = dataclasses.field(default_factory=set)
+    # module-local function names referenced as call ARGUMENTS (higher-order)
+    fn_args: set = dataclasses.field(default_factory=set)
+
+
+class ModuleInfo:
+    """Parsed view of one source file, shared by every rule."""
+
+    def __init__(self, path: Path, rel: str, text: str | None = None):
+        self.path = Path(path)
+        self.rel = rel.replace("\\", "/")
+        self.text = self.path.read_text() if text is None else text
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.module_dotted = _module_dotted(self.rel)
+        # local alias -> absolute dotted module ("jnp" -> "jax.numpy")
+        self.import_aliases: dict[str, str] = {}
+        # local name -> (absolute module, attr) ("push_fanout" ->
+        # ("tpu_gossip.kernels.gossip", "push_fanout"))
+        self.from_imports: dict[str, tuple[str, str]] = {}
+        self.pragmas: dict[int, Pragma] = {}
+        self.functions: list[FuncInfo] = []
+        self._collect_pragmas()
+        self._collect_imports()
+        self._collect_functions()
+
+    # ------------------------------------------------------------- pragmas
+    def _collect_pragmas(self) -> None:
+        """Same-line pragmas suppress their line; a standalone comment-line
+        pragma suppresses the next non-blank, non-comment line (continuation
+        comment lines between them are skipped). Comments come from the
+        TOKENIZER, not a line regex — pragma syntax quoted inside a string
+        or docstring is text, not a suppression."""
+        comments: dict[int, str] = {}
+        standalone: set[int] = set()
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+                    if self.lines[tok.start[0] - 1].strip().startswith("#"):
+                        standalone.add(tok.start[0])
+        except tokenize.TokenError:
+            return  # unterminated construct: the AST parse already raised
+        for i, comment in sorted(comments.items()):
+            m = _PRAGMA_RE.search(comment)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+            prag = Pragma(rules=rules, reason=m.group(2).strip(), line=i)
+            self.pragmas[i] = prag
+            if i in standalone:
+                for j in range(i, len(self.lines)):
+                    nxt = self.lines[j].strip()
+                    if nxt and not nxt.startswith("#"):
+                        self.pragmas.setdefault(j + 1, prag)
+                        break
+
+    # ------------------------------------------------------------- imports
+    def _collect_imports(self) -> None:
+        # function-local imports count too (the engine lazily imports its
+        # kernel deliverers inside _disseminate_local)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+                    if alias.asname:
+                        self.import_aliases[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = (
+                        node.module, alias.name,
+                    )
+
+    def dotted(self, node: ast.AST) -> str | None:
+        """Absolute dotted path of an expression, or None if unresolvable.
+
+        ``Name`` resolves through import aliases and from-imports;
+        ``Attribute`` chains resolve their base the same way. A bare local
+        name with no import mapping resolves to itself (callee-name form
+        for local functions).
+        """
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        base = cur.id
+        if base in self.from_imports:
+            mod, attr = self.from_imports[base]
+            head = f"{mod}.{attr}"
+        elif base in self.import_aliases:
+            head = self.import_aliases[base]
+        else:
+            head = base
+        return ".".join([head] + list(reversed(parts)))
+
+    # ----------------------------------------------------------- functions
+    def _collect_functions(self) -> None:
+        module = self
+
+        def is_jit_decorator(dec: ast.AST) -> bool:
+            d = module.dotted(dec)
+            if d in ("jax.jit", "jax.pmap"):
+                return True
+            if isinstance(dec, ast.Call):
+                cd = module.dotted(dec.func)
+                if cd in ("jax.jit", "jax.pmap"):
+                    return True
+                if cd in ("functools.partial", "partial"):
+                    return any(
+                        module.dotted(a) in ("jax.jit", "jax.pmap")
+                        for a in dec.args
+                    )
+            return False
+
+        def visit(node: ast.AST, parent: FuncInfo | None, prefix: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    fi = FuncInfo(
+                        qualname=qual,
+                        node=child,
+                        parent=parent,
+                        jit_decorated=any(
+                            is_jit_decorator(d) for d in child.decorator_list
+                        ),
+                    )
+                    self._index_calls(fi)
+                    self.functions.append(fi)
+                    visit(child, fi, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    # methods indexed under Class.name; reachable only via
+                    # explicit decoration (attribute dispatch is dynamic)
+                    visit(child, parent, prefix + child.name + ".")
+                else:
+                    visit(child, parent, prefix)
+
+        visit(self.tree, None, "")
+
+    def _index_calls(self, fi: FuncInfo) -> None:
+        """Resolve this function's direct calls + function-valued args."""
+        own_nested = set()
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and sub is not fi.node:
+                own_nested.add(sub.name)
+        for sub in ast.walk(fi.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            target = self._resolve_callable(sub.func)
+            if target is not None:
+                fi.calls.add(target)
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if isinstance(arg, ast.Name) and arg.id not in own_nested:
+                    t = self._resolve_callable(arg)
+                    if t is not None:
+                        fi.fn_args.add(t)
+
+    def _resolve_callable(self, node: ast.AST):
+        """(module_dotted, name) for a callee expression, if resolvable."""
+        if isinstance(node, ast.Name):
+            if node.id in self.from_imports:
+                return self.from_imports[node.id]
+            if node.id in self.import_aliases:
+                return None  # a bare module is not a callable target
+            return (self.module_dotted, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.dotted(node.value)
+            if base is not None:
+                return (base, node.attr)
+        return None
+
+
+def _module_dotted(rel: str) -> str:
+    p = rel[:-3] if rel.endswith(".py") else rel
+    p = p.replace("/", ".")
+    return p[: -len(".__init__")] if p.endswith(".__init__") else p
+
+
+class Project:
+    """All modules + the project-wide jit-reachability fixpoint."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        # (module_dotted, top-level func name) -> (ModuleInfo, FuncInfo)
+        self.symbols: dict[tuple[str, str], tuple[ModuleInfo, FuncInfo]] = {}
+        for m in modules:
+            for fi in m.functions:
+                if "." not in fi.qualname:  # top-level only: call targets
+                    self.symbols[(m.module_dotted, fi.qualname)] = (m, fi)
+        self._reachable: set[int] | None = None
+
+    def jit_reachable(self) -> set:
+        """ids of FuncInfo objects reachable from a jax.jit trace."""
+        if self._reachable is not None:
+            return self._reachable
+        reachable: set[int] = set()
+        info_of: dict[int, tuple[ModuleInfo, FuncInfo]] = {}
+        children: dict[int, list[FuncInfo]] = {}
+        for m in self.modules:
+            for fi in m.functions:
+                info_of[id(fi)] = (m, fi)
+                if fi.parent is not None:
+                    children.setdefault(id(fi.parent), []).append(fi)
+        work = [fi for m in self.modules for fi in m.functions if fi.jit_decorated]
+        while work:
+            fi = work.pop()
+            if id(fi) in reachable:
+                continue
+            reachable.add(id(fi))
+            # nested defs are traced with their parent (scan/while bodies,
+            # shard_map closures, timing lambdas notwithstanding)
+            work.extend(children.get(id(fi), ()))
+            m, _ = info_of[id(fi)]
+            for target in fi.calls | fi.fn_args:
+                hit = self.symbols.get(target)
+                if hit is not None:
+                    work.append(hit[1])
+        self._reachable = reachable
+        return reachable

@@ -17,7 +17,7 @@ import numpy as np
 
 from tpu_gossip.compat.wire import Addr
 from tpu_gossip.core.state import (
-    SwarmConfig, SwarmState, init_swarm, message_slot, message_slots,
+    SwarmConfig, SwarmState, init_swarm, message_slots,
 )
 from tpu_gossip.core.topology import build_csr, preferential_attachment
 from tpu_gossip.sim.engine import simulate

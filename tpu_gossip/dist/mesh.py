@@ -712,6 +712,7 @@ def gossip_round_dist(
         msgs_sent = msgs_sent + jnp.sum(msgs) + jnp.sum(pulls, dtype=jnp.int32)
     if cfg.mode in ("push", "push_pull") and not merged_pp:
         inc, msgs = _exchange(
+            # graftlint: disable=key-linearity -- exclusive with the merged_pp arm at trace time (static cfg.mode dispatch): one split(k_push) per trace
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "push", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
         )
@@ -730,6 +731,7 @@ def gossip_round_dist(
         msgs_sent = msgs_sent + jnp.sum(msgs) + jnp.sum(pulls, dtype=jnp.int32)
     if cfg.mode == "flood":
         inc, msgs = _exchange(
+            # graftlint: disable=key-linearity -- flood excludes both push arms above at trace time; one split(k_push) per trace
             transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "flood", cfg.fanout, shard_plan=shard_plan,
         )

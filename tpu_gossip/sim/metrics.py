@@ -15,7 +15,6 @@ import json
 import time
 from typing import IO, Iterable
 
-import jax
 import numpy as np
 
 from tpu_gossip.core.state import SwarmConfig, SwarmState
