@@ -308,14 +308,19 @@ def bench_liveness(n: int = 1000, silent_frac: float = 0.1, rounds: int = 20,
     silent_ids = rng.choice(n, size=k, replace=False)
     state.silent = state.silent.at[jnp.asarray(silent_ids)].set(True)
 
-    fin, stats = simulate(state, cfg, rounds)  # warm + detection trace
+    # simulate DONATES its state — every run gets a fresh clone, cloned
+    # outside the timed region (sim/engine.py donation contract)
+    from tpu_gossip.core.state import clone_state
+
+    fin, stats = simulate(clone_state(state), cfg, rounds)  # warm + trace
     dead_per_round = np.asarray(stats.n_declared_dead)
     hit = np.nonzero(dead_per_round >= k)[0]
     detection_round = int(hit[0]) + 1 if hit.size else -1
     best = float("inf")
     for _ in range(max(reps, 1)):
+        rep_state = clone_state(state)
         t0 = _time.perf_counter()
-        fin, _ = simulate(state, cfg, rounds)
+        fin, _ = simulate(rep_state, cfg, rounds)
         float(fin.coverage(0))  # completion barrier
         best = min(best, _time.perf_counter() - t0)
     secs = detection_round * cfg.round_seconds if detection_round > 0 else -1.0
@@ -351,7 +356,7 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     import jax
     import numpy as np
 
-    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.core.state import SwarmConfig, clone_state, init_swarm
     from tpu_gossip.sim.engine import (
         remat_capacity, rematerialize_rewired, simulate,
     )
@@ -389,20 +394,23 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     state, _ = rematerialize_rewired(state, cfg, cap)
     seg_plan, _ = rebuild_plan(state)
 
-    fin, _ = simulate(state, cfg, remat_every, seg_plan)  # warm capacity shape
+    # the engines donate their state: clones per run, outside the timer
+    fin, _ = simulate(clone_state(state), cfg, remat_every, seg_plan)  # warm
     float(fin.coverage(0))
     best = float("inf")
     for _ in range(max(reps, 1)):
+        rep_state = clone_state(state)
         t0 = time.perf_counter()
-        fin, _ = simulate(state, cfg, remat_every, seg_plan)
+        fin, _ = simulate(rep_state, cfg, remat_every, seg_plan)
         float(fin.coverage(0))  # completion barrier
         best = min(best, time.perf_counter() - t0)
     seg_ms = best / remat_every * 1000.0
 
-    nxt, ov = rematerialize_rewired(fin, cfg, cap)  # warm the remat itself
+    nxt, ov = rematerialize_rewired(clone_state(fin), cfg, cap)  # warm remat
     int(ov)
+    fin2 = clone_state(fin)
     t0 = time.perf_counter()
-    nxt, ov = rematerialize_rewired(fin, cfg, cap)
+    nxt, ov = rematerialize_rewired(fin2, cfg, cap)
     overflow = int(ov)  # fetch = completion barrier
     remat_s = time.perf_counter() - t0
     # warm THEN time on the SAME state: the device plan build's jit keys on
@@ -448,16 +456,22 @@ def _lint_status() -> dict:
         return {"lint_clean": False, "lint": {"error": repr(e)[:200]}}
 
 
-def _timed_coverage(run, n: int, reps: int):
-    """Warm + min-wall timing of a zero-arg run-to-coverage callable (the
-    scalar fetch is the completion barrier on the axon tunnel)."""
+def _timed_coverage(run, state, n: int, reps: int):
+    """Warm + min-wall timing of a one-arg run-to-coverage callable.
 
-    fin = run()  # warm (compile)
+    ``run(state) -> final_state``; the engines DONATE their state, so every
+    invocation gets a fresh ``clone_state(state)``, cloned outside the
+    timed region (the scalar fetch is the completion barrier on the axon
+    tunnel)."""
+    from tpu_gossip.core.state import clone_state
+
+    fin = run(clone_state(state))  # warm (compile)
     cov, rounds = float(fin.coverage(0)), int(fin.round)
     best = float("inf")
     for _ in range(max(reps, 1)):
+        rep_state = clone_state(state)
         t0 = time.perf_counter()
-        fin = run()
+        fin = run(rep_state)
         float(fin.coverage(0))  # completion barrier
         best = min(best, time.perf_counter() - t0)
     return {
@@ -525,11 +539,12 @@ def bench_dist_matching(n: int, reps: int = 3):
     )
     st = shard_swarm(st0, mesh)
     dist = _timed_coverage(
-        lambda: run_until_coverage_dist(st, cfg, plan_m, mesh, 0.99, 300),
-        n, reps,
+        lambda s: run_until_coverage_dist(s, cfg, plan_m, mesh, 0.99, 300),
+        st, n, reps,
     )
     local = _timed_coverage(
-        lambda: run_until_coverage(st0, cfg, 0.99, 300, plan=plan), n, reps
+        lambda s: run_until_coverage(s, cfg, 0.99, 300, plan=plan),
+        st0, n, reps,
     )
     return {
         "n_peers": n, "devices": mesh.size, "msg_slots": cfg.msg_slots,
@@ -581,18 +596,21 @@ def bench_dist(n: int, reps: int = 3):
     cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
     st0 = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
 
-    def timed(run):
-        return _timed_coverage(run, n, reps)
-
     st = shard_swarm(st0, mesh)
-    dist = timed(lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300))
+
+    def timed(run, state):
+        return _timed_coverage(run, state, n, reps)
+
+    dist = timed(
+        lambda s: run_until_coverage_dist(s, cfg, sg, mesh, 0.99, 300), st
+    )
     # the fused path: per-shard staircase plans replace the receive-side
     # scatter inside shard_map (bit-identical trajectory, VERDICT r3 item 1)
     dist_pal = timed(
-        lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300,
-                                        shard_plan=plans)
+        lambda s: run_until_coverage_dist(s, cfg, sg, mesh, 0.99, 300,
+                                          shard_plan=plans), st
     )
-    local = timed(lambda: run_until_coverage(st0, cfg, 0.99, 300))
+    local = timed(lambda s: run_until_coverage(s, cfg, 0.99, 300), st0)
     return {
         "n_peers": n, "devices": mesh.size, "msg_slots": cfg.msg_slots,
         "dist": dist, "dist_pallas": dist_pal, "local_same_graph": local,

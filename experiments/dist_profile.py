@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_gossip.core.state import SwarmConfig
+from tpu_gossip.core.state import SwarmConfig, clone_state
 from tpu_gossip.core.topology import (
     build_csr, configuration_model, powerlaw_degree_sequence,
 )
@@ -24,13 +24,15 @@ from tpu_gossip.sim.metrics import bench_swarm
 N = 1_000_000
 
 
-def timed(run, reps=3):
-    fin = run()
+def timed(run, state, reps=3):
+    # the engines donate their state: one clone per invocation, pre-timer
+    fin = run(clone_state(state))
     cov, rounds = float(fin.coverage(0)), int(fin.round)
     best = float("inf")
     for _ in range(reps):
+        rep_state = clone_state(state)
         t0 = time.perf_counter()
-        fin = run()
+        fin = run(rep_state)
         float(fin.coverage(0))
         best = min(best, time.perf_counter() - t0)
     return best, rounds, cov
@@ -50,16 +52,18 @@ def main():
     st = shard_swarm(st0, mesh)
     print(f"devices={mesh.size} bucket={sg.bucket} per={sg.per_shard}", flush=True)
 
-    w, r, c = timed(lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300))
+    w, r, c = timed(
+        lambda s: run_until_coverage_dist(s, cfg, sg, mesh, 0.99, 300), st
+    )
     print(f"dist scatter: {w/r*1e3:.1f} ms/round ({r} rounds, cov {c:.4f})",
           flush=True)
     w2, r2, c2 = timed(
-        lambda: run_until_coverage_dist(st, cfg, sg, mesh, 0.99, 300,
-                                        shard_plan=plans)
+        lambda s: run_until_coverage_dist(s, cfg, sg, mesh, 0.99, 300,
+                                          shard_plan=plans), st
     )
     print(f"dist pallas:  {w2/r2*1e3:.1f} ms/round ({r2} rounds, cov {c2:.4f})",
           flush=True)
-    w3, r3, c3 = timed(lambda: run_until_coverage(st0, cfg, 0.99, 300))
+    w3, r3, c3 = timed(lambda s: run_until_coverage(s, cfg, 0.99, 300), st0)
     print(f"local xla:    {w3/r3*1e3:.1f} ms/round ({r3} rounds)", flush=True)
     print(f"overhead_vs_local: scatter {w/r/(w3/r3):.2f}x  "
           f"pallas {w2/r2/(w3/r3):.2f}x", flush=True)

@@ -1,12 +1,20 @@
-"""Slope-timed stage decomposition of the 1M matching round: where do the
-~21 ms/round of the recorded headline go, given the permutation pipeline
-itself costs ~1 ms? Candidates: per-round threshold/gate computation (the
-expand is a 134-slice concat), the second pipeline for rec_slots, the
-protocol tail, RNG, or while_loop condition overhead."""
+"""Slope-timed stage decomposition of the 1M matching round.
+
+Round-5 finding (VERDICT item 7): the permutation pipeline delivers in
+~1.4 ms yet the composed round ran ~14.4 ms — the protocol tail (dedup
+merge, SIR latching, liveness, churn masks) dominated ~10×. The shared
+profiler (tpu_gossip.utils.profiling.profile_round_stages — also behind
+``run_sim --profile-round``) now times the pipeline micro-stages AND the
+tail per implementation (reference multi-pass vs fused single-traversal vs
+the Pallas single-launch kernel); the published table lives in
+docs/round_tail_profile.md.
+
+Usage: ``python experiments/matching_round_profile.py [n]`` (default 1M).
+"""
 
 from __future__ import annotations
 
-import time
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -14,39 +22,26 @@ import numpy as np
 
 from tpu_gossip.core.matching_topology import matching_powerlaw_graph
 from tpu_gossip.core.state import SwarmConfig, init_swarm
-from tpu_gossip.kernels.matching import matching_sampled
-from tpu_gossip.sim.engine import gossip_round, simulate
-
-
-def slope(body, carry, n1, n2, reps=3):
-    def run(iters):
-        f = jax.jit(lambda c: jax.lax.fori_loop(0, iters, body, c))
-        out = f(carry)
-        _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = f(carry)
-            _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    return (run(n2) - run(n1)) / (n2 - n1)
+from tpu_gossip.sim.engine import simulate
+from tpu_gossip.utils.profiling import (
+    format_stage_table, profile_round_stages, slope_time,
+)
 
 
 def main():
-    n = 1_000_000
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     g, plan = matching_powerlaw_graph(n, gamma=2.5, fanout=1, key=jax.random.key(0))
     cfg = SwarmConfig(n_peers=n + 1, msg_slots=16, mode="push_pull", fanout=1)
     state = init_swarm(
         g.as_padded_graph(), cfg, origins=np.arange(16),
         origin_slots=np.arange(16), exists=g.exists,
     )
-    # mid-epidemic state for realistic density
+    # mid-epidemic state for realistic density (simulate donates its input)
     state, _ = simulate(state, cfg, 6, plan)
-    tx = state.seen
-    rec = state.alive
 
+    # pipeline micro-stages (the matching path's internals, unchanged from
+    # the round-5 probe — kept so regressions in the delivery stage itself
+    # stay visible next to the tail rows)
     def t_expand(i, c):
         return c ^ jnp.sum(
             plan.expand(jnp.full((n,), i, jnp.int32)), dtype=jnp.int32
@@ -64,50 +59,20 @@ def main():
             dtype=jnp.int32,
         )
 
-    def t_push_gate(i, c):
-        return c ^ jnp.sum(plan.push_threshold().astype(jnp.int32) + i, dtype=jnp.int32)
-
-    def t_pull_gate(i, c):
-        return c ^ jnp.sum(plan.pull_threshold().astype(jnp.int32) + i, dtype=jnp.int32)
-
-    def t_rng(i, c):
-        k = jax.random.fold_in(jax.random.key(0), i)
-        return c ^ jnp.sum(
-            jax.random.bits(k, (plan.rows, 128), jnp.uint32).astype(jnp.int32),
-            dtype=jnp.int32,
-        )
-
-    def t_delivery(i, c):
-        k = jax.random.fold_in(jax.random.key(1), i)
-        inc, msgs = matching_sampled(
-            plan, tx, None, 16, k, receptive_rows=rec,
-            do_push=True, do_pull=True,
-        )
-        # keep the delivery fold live — msgs alone does not depend on the
-        # reduce/unpack half and XLA would dead-code-eliminate it
-        return c ^ msgs ^ jnp.sum(inc, dtype=jnp.int32)
-
-    st0 = state
-
-    def t_round(i, c):
-        nonlocal_state = jax.lax.cond(
-            i >= 0, lambda s: s, lambda s: s, c
-        )
-        nxt, stats = gossip_round(nonlocal_state, cfg, plan)
-        return nxt
-
-    for name, body, carry, n1, n2 in [
-        ("expand (n->slots)", t_expand, jnp.int32(0), 8, 88),
-        ("partner pipeline", t_partner, jnp.int32(0), 8, 88),
-        ("reduce (slots->n)", t_reduce, jnp.int32(0), 8, 88),
-        ("push gate", t_push_gate, jnp.int32(0), 8, 88),
-        ("pull gate", t_pull_gate, jnp.int32(0), 8, 88),
-        ("rng draw", t_rng, jnp.int32(0), 8, 88),
-        ("matching_sampled full", t_delivery, jnp.int32(0), 4, 44),
-        ("full gossip_round", t_round, st0, 4, 44),
+    for name, body in [
+        ("expand (n->slots)", t_expand),
+        ("partner pipeline", t_partner),
+        ("reduce (slots->n)", t_reduce),
     ]:
-        dt = slope(body, carry, n1, n2)
+        dt = slope_time(body, jnp.int32(0), 8, 88)
         print(f"{name:24s} {dt*1e3:7.2f} ms", flush=True)
+
+    # composed-round decomposition: delivery, tail per implementation,
+    # liveness, stats, rng, and the full round per tail
+    stages = profile_round_stages(
+        state, cfg, plan, tails=("reference", "fused", "pallas"),
+    )
+    print(format_stage_table(stages), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+"""Fixture: jit-state-donation graftlint must catch these."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))  # no donation
+def copying_entry(state, cfg):
+    return state
+
+
+@jax.jit  # bare form, no kwargs at all
+def bare_jit(state):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))  # wrong index (state is 0)
+def wrong_num(state, aux):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("aux",))  # wrong name
+def wrong_name(state, aux):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames="aux")  # bare-string wrong name
+def wrong_bare_string(state, aux):
+    return state
+
+
+def wrapped(state, mode):
+    return state
+
+
+jitted = jax.jit(wrapped, static_argnames=("mode",))  # assignment form

@@ -5,17 +5,21 @@ import functools
 import jax
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "capactiy"))  # typo'd
+@functools.partial(  # typo'd static name; donation declared (not under test)
+    jax.jit, static_argnames=("cfg", "capactiy"), donate_argnames=("state",)
+)
 def renamed_param(state, cfg, capacity: int):
     return state[:capacity]
 
 
-@jax.jit(static_argnames="num_rouns")  # the parameter is num_rounds
+@jax.jit(static_argnames="num_rouns", donate_argnames=("state",))  # the parameter is num_rounds
 def direct_call_form(state, num_rounds: int):
     return state * num_rounds
 
 
-@functools.partial(jax.jit, static_argnums=(3,))  # only 2 positional params
+@functools.partial(  # only 2 positional params
+    jax.jit, static_argnums=(3,), donate_argnames=("state",)
+)
 def nums_out_of_range(state, n):
     return state + n
 
@@ -24,4 +28,6 @@ def wrapped(state, mode):
     return state
 
 
-jitted = jax.jit(wrapped, static_argnames=("moed",))  # assignment form
+jitted = jax.jit(  # assignment form
+    wrapped, static_argnames=("moed",), donate_argnames=("state",)
+)

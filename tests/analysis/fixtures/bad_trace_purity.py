@@ -42,7 +42,7 @@ def item_sync(x):
     return x.sum().item()  # .item() forces a device->host sync
 
 
-@jax.jit
+@jax.jit(donate_argnames=("state",))
 def item_sync_attribute_chain(state):
     # the COMMON form: .item() hanging off an attribute chain
     return state.coverage.item()

@@ -1,0 +1,39 @@
+"""Fixture: jit-state-donation graftlint must NOT flag these."""
+
+import functools
+
+import jax
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnames=("state",)
+)
+def donating_entry(state, cfg):
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def donating_by_num(state, n):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("state", "other"))
+def donating_tuple(state, other):
+    return state
+
+
+@jax.jit
+def no_state_param(x, y):
+    return x + y  # donation not required: nothing is named state
+
+
+def helper(state):
+    return state  # not jitted: the rule only binds jit entry points
+
+
+NAMES = ("state",)
+
+
+@functools.partial(jax.jit, donate_argnames=NAMES)
+def computed_names(state):
+    return state  # non-literal donate_argnames: unprovable, trusted

@@ -5,7 +5,9 @@ import functools
 import jax
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "capacity"))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "capacity"), donate_argnames=("state",)
+)
 def correct(state, cfg, capacity: int):
     return state[:capacity]
 
@@ -15,7 +17,7 @@ def kwonly(plan, x, *, m: int, do_push: bool = True):
     return x if do_push else x[:m]
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
 def nums_in_range(state, n):
     return state + n
 
@@ -24,4 +26,4 @@ def wrapped(state, mode):
     return state
 
 
-jitted = jax.jit(wrapped, static_argnames=("mode",))
+jitted = jax.jit(wrapped, static_argnames=("mode",), donate_argnames=("state",))

@@ -31,8 +31,8 @@ def test_broken_stats_dtype_detected(monkeypatch):
 
     orig = engine.gossip_round
 
-    def broken(state, cfg, plan=None):
-        st, stats = orig(state, cfg, plan)
+    def broken(state, cfg, plan=None, **kw):
+        st, stats = orig(state, cfg, plan, **kw)
         return st, stats._replace(msgs_sent=stats.msgs_sent.astype("float32"))
 
     monkeypatch.setattr(engine, "gossip_round", broken)
@@ -49,10 +49,10 @@ def test_broken_state_shape_detected(monkeypatch):
 
     orig = engine.gossip_round
 
-    def broken(state, cfg, plan=None):
+    def broken(state, cfg, plan=None, **kw):
         import dataclasses
 
-        st, stats = orig(state, cfg, plan)
+        st, stats = orig(state, cfg, plan, **kw)
         return dataclasses.replace(st, alive=st.alive[:-1]), stats
 
     monkeypatch.setattr(engine, "gossip_round", broken)

@@ -132,3 +132,33 @@ def test_pragma_with_reason_suppresses_silently(tmp_path):
     p.write_text(src)
     findings = lint_paths([str(p)], root=tmp_path, project_wide=False)
     assert findings == [], [f.render() for f in findings]
+
+
+# ---------------------------------------------------- jit-state-donation
+def test_missing_state_donation_flagged():
+    findings, rules = _rules_hit("bad_donation.py")
+    assert rules == {"jit-state-donation"}
+    # partial-without-donation, bare @jax.jit, wrong donate_argnums index,
+    # wrong donate_argnames name (tuple AND bare-string forms),
+    # assignment form
+    assert len(findings) == 6, [f.render() for f in findings]
+
+
+def test_declared_donation_clean():
+    findings, _ = _rules_hit("good_donation.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_repo_round_entry_points_all_donate():
+    """The live entry points themselves: the rule that exists to stop future
+    regressions must find the current tree clean."""
+    from tpu_gossip.analysis.cli import repo_root
+
+    root = repo_root()
+    findings = lint_paths(
+        ["tpu_gossip/sim/engine.py", "tpu_gossip/dist/mesh.py"],
+        root=root, project_wide=False,
+    )
+    assert [f for f in findings if f.rule == "jit-state-donation"] == [], [
+        f.render() for f in findings
+    ]

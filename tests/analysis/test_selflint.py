@@ -40,4 +40,5 @@ def test_all_rules_registered():
         "raw-shard-map",
         "trace-purity",
         "static-argnames-drift",
+        "jit-state-donation",
     }

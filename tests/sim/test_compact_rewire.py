@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_gossip import SwarmConfig, build_csr, init_swarm
+from tpu_gossip.core.state import clone_state
 from tpu_gossip.core.topology import configuration_model, powerlaw_degree_sequence
 from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 from tpu_gossip.sim.engine import simulate
@@ -29,17 +30,19 @@ def test_compact_stale_and_fresh_semantics_kernel_path():
         rewired=st.rewired.at[1].set(True),
         rewire_targets=st.rewire_targets.at[1, 0].set(2),
     )
-    fin, _ = simulate(rw, cfg, 5, plan)
+    fin, _ = simulate(clone_state(rw), cfg, 5, plan)
     seen = np.asarray(fin.seen)
     assert not seen[1, 0] and not seen[2, 0], "stale CSR push leaked (compact)"
     assert seen[1, 1], "reverse-fresh push lost (compact)"
 
-    rw_origin1 = dataclasses.replace(rw, seen=st.seen.at[1, 2].set(True))
+    rw_origin1 = dataclasses.replace(
+        clone_state(rw), seen=st.seen.at[1, 2].set(True)
+    )
     fin_fresh, _ = simulate(rw_origin1, cfg, 5, plan)
     assert bool(fin_fresh.seen[2, 2]), "fresh-edge push lost (compact)"
 
     cfg_pp = dataclasses.replace(cfg, mode="push_pull")
-    fin_pull, _ = simulate(rw, cfg_pp, 5, plan)
+    fin_pull, _ = simulate(clone_state(rw), cfg_pp, 5, plan)
     assert bool(fin_pull.seen[1, 1]), "fresh-edge pull lost (compact)"
 
 

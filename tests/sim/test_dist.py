@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from tpu_gossip import SwarmConfig, build_csr, preferential_attachment
+from tpu_gossip.core.state import clone_state
 from tpu_gossip.dist import (
     build_shard_plans,
     init_sharded_swarm,
@@ -250,7 +251,7 @@ def test_kernel_receive_path_bit_parity(setup, mode, extra):
     st = shard_swarm(
         init_sharded_swarm(sg, relabeled, position, cfg, origins=[0, 1],
                            key=jax.random.key(3)), mesh)
-    fin_a, stats_a = simulate_dist(st, cfg, sg, mesh, 6)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 6)
     fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 6, plans)
     np.testing.assert_array_equal(np.asarray(fin_a.seen), np.asarray(fin_b.seen))
     np.testing.assert_array_equal(
@@ -278,7 +279,7 @@ def test_kernel_receive_path_multiword(setup):
         st, seen=st.seen.at[position[np.arange(48)], np.arange(48)].set(True)
     )
     st = shard_swarm(st, mesh)
-    fin_a, _ = simulate_dist(st, cfg, sg, mesh, 4)
+    fin_a, _ = simulate_dist(clone_state(st), cfg, sg, mesh, 4)
     fin_b, _ = simulate_dist(st, cfg, sg, mesh, 4, plans)
     seen_a = np.asarray(fin_a.seen)
     assert seen_a[:, 32:].any(), "second word group never carried traffic"
@@ -299,9 +300,14 @@ def test_dist_checkpoint_resume_local(tmp_path):
     mid, _ = simulate_dist(st, cfg, sg, mesh, 3)
     save_swarm(tmp_path / "dist.npz", mid)
     restored = load_swarm(tmp_path / "dist.npz")
-    # dist-engine resume on the same mesh: identical trajectory
+    # dist-engine resume on the same mesh: identical trajectory. shard_swarm
+    # may ALIAS replicated leaves (device_put reuses the source buffer for
+    # the device it already lives on), so the donated sharded copy is made
+    # from a clone — `restored` must survive for the local-engine resume
     fin_a, _ = simulate_dist(mid, cfg, sg, mesh, 3)
-    fin_b, _ = simulate_dist(shard_swarm(restored, mesh), cfg, sg, mesh, 3)
+    fin_b, _ = simulate_dist(
+        shard_swarm(clone_state(restored), mesh), cfg, sg, mesh, 3
+    )
     np.testing.assert_array_equal(np.asarray(fin_a.seen), np.asarray(fin_b.seen))
     assert int(fin_b.round) == 6
     # local-engine resume runs too (same state machine, single shard)
@@ -366,7 +372,7 @@ def test_matching_dist_bit_identical_to_single_chip(matching_setup, mode, extra)
     g, plan, plan_m, mesh = matching_setup
     cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode=mode, **extra)
     st = _matching_state(g, cfg)
-    fin_l, stats_l = simulate(st, cfg, 5, plan)
+    fin_l, stats_l = simulate(clone_state(st), cfg, 5, plan)
     fin_d, stats_d = simulate_dist(shard_swarm(st, mesh), cfg, plan_m, mesh, 5)
     np.testing.assert_array_equal(np.asarray(fin_l.seen), np.asarray(fin_d.seen))
     np.testing.assert_array_equal(
@@ -405,7 +411,7 @@ def test_matching_dist_multiword(matching_setup):
     st = dataclasses.replace(
         st, seen=st.seen.at[rows, np.arange(48)].set(True)
     )
-    fin_l, _ = simulate(st, cfg, 3, plan)
+    fin_l, _ = simulate(clone_state(st), cfg, 3, plan)
     fin_d, _ = simulate_dist(shard_swarm(st, mesh), cfg, plan_m, mesh, 3)
     seen_l = np.asarray(fin_l.seen)
     assert seen_l[:, 32:].any(), "second word group never carried traffic"

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+from tpu_gossip.core.state import clone_state
 from tpu_gossip.sim.engine import gossip_round, run_until_coverage, simulate
 
 N = 512
@@ -47,7 +48,7 @@ def test_push_pull_faster_than_push(graph):
 
 def test_run_until_coverage_matches_scan_curve(graph):
     cfg, st = make(graph)
-    fin = run_until_coverage(st, cfg, 0.99, 100)
+    fin = run_until_coverage(clone_state(st), cfg, 0.99, 100)
     rounds = int(fin.round)
     _, stats = simulate(st, cfg, rounds)
     cov = np.asarray(stats.coverage)
@@ -57,7 +58,7 @@ def test_run_until_coverage_matches_scan_curve(graph):
 
 def test_determinism(graph):
     cfg, st = make(graph)
-    a, sa = simulate(st, cfg, 10)
+    a, sa = simulate(clone_state(st), cfg, 10)
     b, sb = simulate(st, cfg, 10)
     np.testing.assert_array_equal(np.asarray(a.seen), np.asarray(b.seen))
     np.testing.assert_array_equal(np.asarray(sa.coverage), np.asarray(sb.coverage))
@@ -67,7 +68,7 @@ def test_dedup_no_reinfection(graph):
     """Hash-slot dedup: a seen bit never unsets, and infected_round is latched."""
     cfg, st = make(graph)
     mid, _ = simulate(st, cfg, 5)
-    fin, _ = simulate(mid, cfg, 5)
+    fin, _ = simulate(clone_state(mid), cfg, 5)
     m_seen = np.asarray(mid.seen)
     f_seen = np.asarray(fin.seen)
     assert np.all(f_seen[m_seen])  # no bit lost
@@ -187,7 +188,7 @@ def test_stale_edges_blocked_fresh_edges_bidirectional():
         rewired=st.rewired.at[1].set(True),
         rewire_targets=st.rewire_targets.at[1, 0].set(2),
     )
-    fin, _ = simulate(rw, cfg, 5)
+    fin, _ = simulate(clone_state(rw), cfg, 5)
     seen = np.asarray(fin.seen)
     # stale CSR edge 0->1 delivers nothing (slot 0 never reaches 1 or 2)
     assert not seen[1, 0] and not seen[2, 0], "stale CSR push leaked"
@@ -195,18 +196,20 @@ def test_stale_edges_blocked_fresh_edges_bidirectional():
     assert seen[1, 1], "reverse-fresh push lost — rejoiner unreachable"
 
     # the rejoiner's OWN traffic flows outward over its fresh edge
-    rw_origin1 = dataclasses.replace(rw, seen=st.seen.at[1, 2].set(True))
+    rw_origin1 = dataclasses.replace(
+        clone_state(rw), seen=st.seen.at[1, 2].set(True)
+    )
     fin_fresh, _ = simulate(rw_origin1, cfg, 5)
     assert bool(fin_fresh.seen[2, 2]), "fresh-edge push from a rewired peer lost"
 
     # pull over a fresh edge delivers too (push_pull, rewired puller)
     cfg_pp = dataclasses.replace(cfg, mode="push_pull")
-    fin_pull, _ = simulate(rw, cfg_pp, 5)
+    fin_pull, _ = simulate(clone_state(rw), cfg_pp, 5)
     assert bool(fin_pull.seen[1, 1]), "fresh-edge pull by a rewired peer lost"
 
     # sanity: with the rewire flag cleared the CSR edge infects peer 1 again
     st2 = dataclasses.replace(rw, rewired=rw.rewired.at[1].set(False))
-    fin2, _ = simulate(st2, cfg, 5)
+    fin2, _ = simulate(st2, cfg, 5)  # last use of rw's leaves
     assert bool(fin2.seen[1, 0])
 
 
@@ -294,7 +297,9 @@ def test_resume_equivalence_full_state_machine(tmp_path):
     )
     st0 = init_swarm(g, cfg, origins=[0, 7], key=jax.random.key(9))
 
-    mid, _ = simulate(st0, cfg, 4)
+    from tpu_gossip.core.state import clone_state as _clone
+
+    mid, _ = simulate(_clone(st0), cfg, 4)
     save_swarm(tmp_path / "mid.npz", mid)
     resumed, _ = simulate(load_swarm(tmp_path / "mid.npz"), cfg, 4)
     straight, _ = simulate(st0, cfg, 8)
@@ -323,7 +328,7 @@ def test_resume_equivalence_pallas_path(tmp_path):
     plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=cfg.fanout)
     st0 = init_swarm(g, cfg, origins=[3], key=jax.random.key(10))
 
-    mid, _ = simulate(st0, cfg, 3, plan)
+    mid, _ = simulate(clone_state(st0), cfg, 3, plan)
     save_swarm(tmp_path / "mid.npz", mid)
     resumed, _ = simulate(load_swarm(tmp_path / "mid.npz"), cfg, 3, plan)
     straight, _ = simulate(st0, cfg, 6, plan)

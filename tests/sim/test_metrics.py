@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+from tpu_gossip.core.state import clone_state
 from tpu_gossip.cli.run_sim import main as run_sim_main
 from tpu_gossip.sim import metrics as M
 from tpu_gossip.sim.engine import simulate
@@ -21,7 +22,7 @@ def setup():
 
 def test_rounds_to_coverage(setup):
     cfg, st = setup
-    _, stats = simulate(st, cfg, 25)
+    _, stats = simulate(clone_state(st), cfg, 25)
     r = M.rounds_to_coverage(stats, 0.99)
     cov = np.asarray(stats.coverage)
     assert r > 0 and cov[r - 1] >= 0.99
@@ -32,7 +33,7 @@ def test_rounds_to_coverage(setup):
 def test_bench_swarm_agrees_with_curve(setup):
     cfg, st = setup
     res, _fin = M.bench_swarm(st, cfg, 0.99, 200)
-    _, stats = simulate(st, cfg, res.rounds)
+    _, stats = simulate(clone_state(st), cfg, res.rounds)
     assert float(np.asarray(stats.coverage)[-1]) >= 0.99
     assert res.coverage >= 0.99
     assert res.peers_rounds_per_sec > 0
@@ -41,7 +42,7 @@ def test_bench_swarm_agrees_with_curve(setup):
 
 def test_jsonl_rows(setup):
     cfg, st = setup
-    _, stats = simulate(st, cfg, 5)
+    _, stats = simulate(clone_state(st), cfg, 5)
     buf = io.StringIO()
     M.write_jsonl(stats, buf)
     rows = [json.loads(line) for line in buf.getvalue().splitlines()]

@@ -12,12 +12,22 @@ import numpy as np
 import pytest
 
 from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+from tpu_gossip.core.state import clone_state
 from tpu_gossip.kernels.gossip import flood_all
 from tpu_gossip.sim.engine import (
     remat_capacity,
     rematerialize_rewired,
     simulate,
 )
+
+# rematerialize_rewired donates its state but the CSR leaves change
+# shape (capacity padding), so XLA reports them as unusable donations
+# at every compile — expected here, and the REAL donation behavior is
+# asserted directly by the donation tests
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:Some donated buffers were not usable"
+)
+
 
 
 def _churned_state(n=400, rewired_frac=0.15, seed=0):
@@ -65,7 +75,7 @@ def _expected_edges(g, st, cfg):
 def test_remat_edge_algebra_and_invariants():
     g, cfg, st = _churned_state()
     cap = remat_capacity(st, cfg)
-    new, overflow = rematerialize_rewired(st, cfg, cap)
+    new, overflow = rematerialize_rewired(clone_state(st), cfg, cap)
     assert int(overflow) == 0
     assert not bool(jnp.any(new.rewired))
     assert bool(jnp.all(new.rewire_targets == -1))
@@ -98,7 +108,7 @@ def test_remat_flood_matches_fresh_csr_build():
     build_csr of the same surviving edge set (tail self-loops included —
     they must contribute nothing)."""
     g, cfg, st = _churned_state(seed=3)
-    new, _ = rematerialize_rewired(st, cfg, remat_capacity(st, cfg))
+    new, _ = rematerialize_rewired(clone_state(st), cfg, remat_capacity(st, cfg))
     edges = _expected_edges(g, st, cfg)
     und = np.asarray(sorted({(min(a, b), max(a, b)) for a, b in edges}))
     ref = build_csr(g.n, und)
@@ -228,7 +238,9 @@ def test_remat_identity_when_nothing_rewired(mode):
                                              rng=np.random.default_rng(33)))
     cfg = SwarmConfig(n_peers=n, msg_slots=4, fanout=2, mode=mode, rewire_slots=1)
     st = init_swarm(g, cfg, origins=[0])
-    new, overflow = rematerialize_rewired(st, cfg, int(st.col_idx.shape[0]))
+    new, overflow = rematerialize_rewired(
+        clone_state(st), cfg, int(st.col_idx.shape[0])
+    )
     assert int(overflow) == 0
     np.testing.assert_array_equal(np.asarray(new.row_ptr), np.asarray(st.row_ptr))
     # same multiset of neighbors per row

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tpu_gossip.core.state import clone_state
+
 from tpu_gossip.core.topology import build_csr, configuration_model, powerlaw_degree_sequence, preferential_attachment
 from tpu_gossip.kernels.gossip import flood_all
 from tpu_gossip.kernels.pallas_segment import (
@@ -282,7 +284,7 @@ def test_engine_sampled_kernel_push_mode():
     cfg = SwarmConfig(n_peers=1500, msg_slots=4, fanout=3, mode="push")
     plan = build_staircase_plan(g.row_ptr, g.col_idx, fanout=cfg.fanout)
     st = init_swarm(g, cfg, origins=[0], key=jax.random.key(2))
-    fin = run_until_coverage(st, cfg, 0.99, 60, plan=plan)
+    fin = run_until_coverage(clone_state(st), cfg, 0.99, 60, plan=plan)
     assert float(fin.coverage(0)) >= 0.99
     r_xla = int(run_until_coverage(st, cfg, 0.99, 60).round)
     assert abs(int(fin.round) - r_xla) <= 3, (int(fin.round), r_xla)
@@ -310,7 +312,7 @@ def test_engine_churn_kernel_stale_and_fresh_semantics():
         rewired=st.rewired.at[1].set(True),
         rewire_targets=st.rewire_targets.at[1, 0].set(2),
     )
-    fin, _ = simulate(rw, cfg, 5, plan)
+    fin, _ = simulate(clone_state(rw), cfg, 5, plan)
     seen = np.asarray(fin.seen)
     # stale CSR edge 0->1 delivers nothing (slot 0 never reaches 1 or 2)
     assert not seen[1, 0] and not seen[2, 0], "stale CSR push leaked via kernel"
@@ -318,13 +320,15 @@ def test_engine_churn_kernel_stale_and_fresh_semantics():
     assert seen[1, 1], "reverse-fresh push lost on the kernel path"
 
     # the rejoiner's OWN traffic flows outward over its fresh edge
-    rw_origin1 = dataclasses.replace(rw, seen=st.seen.at[1, 2].set(True))
+    rw_origin1 = dataclasses.replace(
+        clone_state(rw), seen=st.seen.at[1, 2].set(True)
+    )
     fin_fresh, _ = simulate(rw_origin1, cfg, 5, plan)
     assert bool(fin_fresh.seen[2, 2]), "fresh-edge push from a rewired peer lost"
 
     # pull over a fresh edge delivers too (push_pull, rewired puller)
     cfg_pp = dataclasses.replace(cfg, mode="push_pull")
-    fin_pull, _ = simulate(rw, cfg_pp, 5, plan)
+    fin_pull, _ = simulate(clone_state(rw), cfg_pp, 5, plan)
     assert bool(fin_pull.seen[1, 1]), "fresh-edge pull by a rewired peer lost"
 
     # sanity: with the rewire flag cleared the CSR edge infects peer 1 again
@@ -366,7 +370,7 @@ def test_engine_churn_kernel_isolated_rewired_rows_untouched():
         rewired=st.rewired.at[rw_ids].set(True),
         rewire_targets=st.rewire_targets.at[rw_ids, :].set(-1),
     )
-    fin, _ = simulate(rw, cfg, 8, plan)
+    fin, _ = simulate(clone_state(rw), cfg, 8, plan)
     seen = np.asarray(fin.seen)
     rw_mask = np.asarray(rw.rewired)
     # saturated fanout floods every non-rewired peer, so leakage is decisive:
@@ -438,7 +442,7 @@ def test_engine_flood_with_plan_matches_without():
     plan = build_staircase_plan(g.row_ptr, g.col_idx)
     cfg = SwarmConfig(n_peers=700, msg_slots=8, mode="flood")
     st = init_swarm(g, cfg, origins=[0, 13], key=jax.random.key(3))
-    fin_a, stats_a = simulate(st, cfg, 6)
+    fin_a, stats_a = simulate(clone_state(st), cfg, 6)
     fin_b, stats_b = simulate(st, cfg, 6, plan)
     assert bool(jnp.array_equal(fin_a.seen, fin_b.seen))
     np.testing.assert_array_equal(np.asarray(stats_a.coverage), np.asarray(stats_b.coverage))

@@ -22,3 +22,72 @@ def test_trace_disabled_is_noop(tmp_path):
     with trace(""):
         pass
     assert list(tmp_path.iterdir()) == []
+
+
+def test_slope_time_measures_positive_per_iteration_cost():
+    from tpu_gossip.utils.profiling import slope_time
+
+    x = jnp.arange(1 << 16, dtype=jnp.int32)
+
+    def body(i, c, arr):
+        return c ^ jnp.sum(arr + i, dtype=jnp.int32)
+
+    dt = slope_time(body, jnp.int32(0), 2, 50, reps=2, operands=(x,))
+    assert dt == dt and dt > 0  # finite, positive
+
+
+def test_profile_round_stages_covers_every_stage():
+    """The stage decomposition (run_sim --profile-round): every declared
+    stage present, tails selectable, values floats (NaN allowed at toy
+    scales where noise wins the slope)."""
+    import numpy as np
+
+    from tpu_gossip import SwarmConfig, build_csr, init_swarm, preferential_attachment
+    from tpu_gossip.core.state import clone_state
+    from tpu_gossip.sim.engine import simulate
+    from tpu_gossip.utils.profiling import (
+        format_stage_table, profile_round_stages,
+    )
+
+    n = 512
+    g = build_csr(n, preferential_attachment(n, m=3, use_native=False))
+    cfg = SwarmConfig(
+        n_peers=n, msg_slots=8, fanout=2, mode="push_pull",
+        churn_leave_prob=0.02, churn_join_prob=0.1, rewire_slots=2,
+    )
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(0))
+    st, _ = simulate(clone_state(st), cfg, 3)
+    stages = profile_round_stages(
+        st, cfg, None, reps=1, loop_lengths=(2, 6),
+        tails=("reference", "fused", "pallas"),
+    )
+    want = {
+        "delivery", "liveness", "stats", "rng",
+        "tail[reference]", "tail[fused]", "tail[pallas]",
+        "full_round[reference]", "full_round[fused]", "full_round[pallas]",
+    }
+    assert set(stages) == want
+    assert all(isinstance(v, float) for v in stages.values())
+    table = format_stage_table(stages)
+    assert "| stage | ms/round |" in table and "tail[fused]" in table
+
+
+def test_run_sim_profile_round_cli(capsys):
+    import json
+
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    rc = run_sim_main([
+        "--peers", "256", "--mode", "push_pull", "--fanout", "2",
+        "--profile-round", "2", "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    row = json.loads(out)  # strict JSON — NaNs must have become null
+    assert row["profile_round"] is True
+    assert "tail[fused]" in row["stages_ms"]
+    # --shard is the dist engines' territory: loud exit, not silence
+    rc = run_sim_main([
+        "--peers", "64", "--profile-round", "1", "--shard",
+    ])
+    assert rc == 2

@@ -28,6 +28,7 @@ from tpu_gossip.analysis.registry import RULES, Finding, run_rules
 
 # importing the rule modules registers them
 from tpu_gossip.analysis import (  # noqa: F401  (registration imports)
+    rules_donation,
     rules_prng,
     rules_purity,
     rules_shardmap,

@@ -267,6 +267,25 @@ def _check_gossip_round() -> list:
             continue
         _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
         _stats_contract(out_stats, problems)
+    # every tail implementation (kernels/round_tail.py) must keep the round
+    # a state fixed point — the rail that makes aggressive fusion safe: a
+    # tail that drops, reshapes, or re-types a slot array cannot reach a
+    # scan/while_loop carry without failing here first. Churn + SIR ride
+    # along so the fresh-mask and recovery branches are traced too.
+    st, cfg = ctx["state_for"](
+        ctx["dg"], 16, mode="push_pull", sir_recover_rounds=4, **churn
+    )
+    for tail in ("reference", "fused", "pallas"):
+        name = f"gossip_round[tail={tail}]"
+        try:
+            out_st, out_stats = jax.eval_shape(
+                lambda s, t=tail: engine.gossip_round(s, cfg, tail=t), st
+            )
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
+            continue
+        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
+        _stats_contract(out_stats, problems)
     return problems
 
 

@@ -1,0 +1,127 @@
+"""jit-state-donation: jitted round entry points must donate their state.
+
+The invariant: every ``jax.jit``-wrapped function whose signature carries a
+``state`` parameter is a round entry point moving the whole ~N×M-slot
+``SwarmState`` pytree through the device — ``simulate``,
+``run_until_coverage``, ``rematerialize_rewired``, the two dist engines.
+Without ``donate_argnames=("state",)`` XLA must preserve the input buffers
+and the call copies the entire state (~170 MB at 1M×16, every invocation).
+The repo's donation contract (sim/engine.py, core.state.clone_state) makes
+the alias explicit; a future entry point written without the declaration
+would silently regress to copying — the exact class of quiet performance
+rot this rule exists to stop.
+
+Covered jit shapes (the same ones static-argnames-drift parses):
+
+- ``@functools.partial(jax.jit, ...)`` (the repo idiom)
+- ``@jax.jit`` bare or with keywords
+- ``f = jax.jit(g, ...)`` at module level, ``g`` local
+
+A function that genuinely must NOT donate (its callers reuse the input)
+carries a pragma with the reason:
+``# graftlint: disable=jit-state-donation -- <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_gossip.analysis.registry import Finding, rule
+from tpu_gossip.analysis.rules_staticargs import _jit_call_kwargs, _param_names
+from tpu_gossip.analysis.walker import ModuleInfo
+
+__all__ = ["check_state_donation"]
+
+_STATE = "state"
+
+
+def _positional_index(fn: ast.AST, name: str) -> int | None:
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    return pos.index(name) if name in pos else None
+
+
+def _declares_donation(fn: ast.AST, kwargs) -> bool:
+    """True when donate_argnames names 'state' (literal) or donate_argnums
+    covers its positional index. Computed (non-literal) values are treated
+    as declared — unprovable either way, and the rule must not cry wolf."""
+    for kw in kwargs:
+        if kw.arg == "donate_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                # bare-string form is fully provable: only 'state' counts
+                return v.value == _STATE
+            if isinstance(v, (ast.Tuple, ast.List)):
+                names = [
+                    el.value
+                    for el in v.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                ]
+                if _STATE in names or len(names) < len(v.elts):
+                    return True  # named, or partially non-literal: trust it
+                continue
+            return True  # computed expression: unprovable, trust it
+        if kw.arg == "donate_argnums":
+            idx = _positional_index(fn, _STATE)
+            v = kw.value
+            els = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            nums = [
+                el.value
+                for el in els
+                if isinstance(el, ast.Constant) and isinstance(el.value, int)
+            ]
+            if idx is not None and idx in nums:
+                return True
+            if len(nums) < len(els):
+                return True  # non-literal entries: unprovable, trust it
+    return False
+
+
+def _finding(module: ModuleInfo, node: ast.AST, fname: str) -> Finding:
+    return Finding(
+        file=module.rel,
+        line=node.lineno,
+        col=node.col_offset + 1,
+        rule="jit-state-donation",
+        message=(
+            f"jitted entry point {fname} takes `state` but does not donate "
+            "it — every call copies the full SwarmState pytree"
+        ),
+        hint="add donate_argnames=(\"state\",) and make callers thread the "
+        "result or pass core.state.clone_state(state); a deliberate "
+        "non-donating entry point takes a pragma with its reason",
+    )
+
+
+@rule("jit-state-donation")
+def check_state_donation(module: ModuleInfo):
+    # decorated functions (nested included)
+    for fi in module.functions:
+        for dec in fi.node.decorator_list:
+            if module.dotted(dec) in ("jax.jit", "jax.pmap"):
+                # bare @jax.jit: no kwargs at all
+                if _STATE in _param_names(fi.node):
+                    yield _finding(module, dec, fi.qualname)
+                continue
+            kwargs = _jit_call_kwargs(module, dec)
+            if kwargs is None:
+                continue
+            if _STATE in _param_names(fi.node) and not _declares_donation(
+                fi.node, kwargs
+            ):
+                yield _finding(module, dec, fi.qualname)
+    # assignment form: f = jax.jit(g, ...)
+    top_level = {
+        fi.qualname: fi.node for fi in module.functions if "." not in fi.qualname
+    }
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kwargs = _jit_call_kwargs(module, node)
+        if kwargs is None or not node.args:
+            continue
+        wrapped = node.args[0]
+        if isinstance(wrapped, ast.Name) and wrapped.id in top_level:
+            fn = top_level[wrapped.id]
+            if _STATE in _param_names(fn) and not _declares_donation(fn, kwargs):
+                yield _finding(module, node, wrapped.id)

@@ -88,6 +88,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--staircase, which then routes each shard's receive side through "
         "the per-shard staircase kernel (the north-star fusion)",
     )
+    p.add_argument(
+        "--tail", choices=["fused", "reference", "pallas"], default="fused",
+        help="protocol-tail implementation (kernels/round_tail.py): fused "
+        "(single lax traversal, the default), reference (the historical "
+        "multi-pass sequence — the bitwise oracle), pallas (one kernel "
+        "launch; interpret-mode on CPU). All three are bit-identical; "
+        "local engine only",
+    )
+    p.add_argument(
+        "--profile-round", type=int, default=0, metavar="R",
+        help="instead of the normal run: advance R warm rounds, then "
+        "slope-time the round's stage decomposition (delivery, tail per "
+        "implementation, liveness, stats, rng, composed round — "
+        "utils.profiling.profile_round_stages) and print it as the summary "
+        "JSON. Local engine only; the published table lives in "
+        "docs/round_tail_profile.md",
+    )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
     p.add_argument(
@@ -109,6 +126,19 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_gossip.sim.engine import simulate
 
     rng = np.random.default_rng(args.seed)
+    if args.profile_round > 0 and args.shard:
+        print("--profile-round decomposes the LOCAL round (use "
+              "experiments/dist_profile.py for the mesh engines)",
+              file=sys.stderr)
+        return 2
+    if args.tail != "fused" and args.shard:
+        # the dist engines run advance_round's default tail; a summary that
+        # silently measured the wrong tail would be worse than an error
+        print(f"--tail {args.tail} selects the LOCAL engine's tail "
+              "implementation; the sharded engines always run the fused "
+              "tail (bit-identical, but not the A/B you asked for)",
+              file=sys.stderr)
+        return 2
     mplan = exists = None
     if args.graph == "matching":
         if args.shard:
@@ -176,16 +206,22 @@ def main(argv: list[str] | None = None) -> int:
 
     from tpu_gossip.utils.profiling import trace
 
+    if args.profile_round > 0:
+        return _main_profile_round(args, cfg, state, plan)
+
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_with_remat(args, cfg, state)
         elif args.rounds > 0:
-            fin, stats = simulate(state, cfg, args.rounds, plan)
+            fin, stats = simulate(state, cfg, args.rounds, plan, args.tail)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats)
         else:
-            result, fin = M.bench_swarm(state, cfg, args.target, args.max_rounds, plan=plan)
+            result, fin = M.bench_swarm(
+                state, cfg, args.target, args.max_rounds, plan=plan,
+                tail=args.tail,
+            )
             summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
     print(json.dumps(summary))
 
@@ -194,15 +230,57 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def _main_profile_round(args, cfg, state, plan) -> int:
+    """--profile-round R: the slope-timed stage decomposition of one round.
+
+    Advances R rounds first (mid-epidemic slot densities — a cold state
+    makes every stage trivially sparse), then times each stage and the
+    composed round per tail implementation. The summary JSON carries
+    ms-per-round figures; the human-readable table goes to stderr.
+    """
+    from tpu_gossip.core.state import clone_state
+    from tpu_gossip.sim.engine import simulate
+    from tpu_gossip.utils.profiling import (
+        format_stage_table, profile_round_stages, trace,
+    )
+
+    warm, _ = simulate(clone_state(state), cfg, args.profile_round, plan)
+    tails = ("reference", "fused") if args.tail != "pallas" else (
+        "reference", "fused", "pallas",
+    )
+    with trace(args.profile):  # --profile DIR composes: xprof the stages
+        stages = profile_round_stages(warm, cfg, plan, tails=tails)
+    print(format_stage_table(stages), file=sys.stderr)
+    import math
+
+    print(json.dumps({
+        "summary": True, "profile_round": True, "mode": args.mode,
+        "n_peers": args.peers, "warm_rounds": args.profile_round,
+        # NaN (slope lost to noise at tiny scales) -> null: the summary
+        # line must stay strictly parseable JSON
+        "stages_ms": {
+            k: (round(v * 1e3, 4) if math.isfinite(v) else None)
+            for k, v in stages.items()
+        },
+    }))
+    return 0
+
+
 def _run_with_remat(args, cfg, state):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
-    The first re-materialization pads col_idx to the fixed capacity (one
-    extra compile); every later segment shares that shape. With
-    --staircase, the plan is rebuilt from the current CSR per segment (the
-    topology it tiles changed)."""
+    The first re-materialization pads col_idx to the fixed capacity, so the
+    timed loop sees TWO segment shapes (the original CSR and the
+    capacity-padded one) and two remat input shapes. ALL four compiles are
+    warmed outside the timed region on throwaway clones — previously only
+    the pre-remat segment was warmed and the first post-remat segment's
+    compile landed inside the wall clock, polluting ms_per_round (ADVICE
+    leftover / VERDICT r5 item 8). With --staircase, the plan is rebuilt
+    from the current CSR per segment (the topology it tiles changed); the
+    host plan build is real per-segment work and stays inside."""
     import time as _time
 
+    from tpu_gossip.core.state import clone_state
     from tpu_gossip.sim import metrics as M
     from tpu_gossip.sim.engine import (
         remat_capacity,
@@ -218,39 +296,50 @@ def _run_with_remat(args, cfg, state):
     overflow_total = 0
     stats_parts = []
 
-    def seg_plan():
+    def seg_plan(st):
         if not args.staircase:
             return None
         from tpu_gossip.kernels.pallas_segment import build_staircase_plan
 
         return build_staircase_plan(
-            np.asarray(state.row_ptr), np.asarray(state.col_idx),
+            np.asarray(st.row_ptr), np.asarray(st.col_idx),
             fanout=None if args.mode == "flood" else args.fanout,
         )
 
-    # warm the first segment's compiles OUTSIDE the timed region (same
-    # static shapes as the loop body) so the summary's ms_per_round is
-    # comparable with bench_swarm's compile-excluded figures (the remat
-    # compile still lands inside — it only exists on this path and is part
-    # of its cost)
-    warm_plan = seg_plan()
+    def run_segment(st, seg, plan):
+        if args.rounds > 0:
+            return simulate(st, cfg, seg, plan, args.tail)
+        return run_until_coverage(
+            st, cfg, args.target, seg, plan=plan, tail=args.tail
+        ), None
+
+    # warm EVERY shape the timed loop will see, on throwaway clones:
+    # pre-remat segment, the fold at the original CSR shape, the
+    # capacity-shaped segment (with its rebuilt plan), the fold at the
+    # capacity shape (all later folds), and — when total is not a multiple
+    # of remat_every — the TRUNCATED final segment (segment length is a
+    # static jit argument, so it is its own compile) — compile-free timed
+    # region
     seg0 = min(r, total - int(state.round))
-    if args.rounds > 0:
-        warm = simulate(state, cfg, seg0, warm_plan)[0]
-    else:
-        warm = run_until_coverage(state, cfg, args.target, seg0, plan=warm_plan)
-    float(warm.coverage(0))  # fetch = completion barrier on axon
-    del warm, warm_plan
+    warm, _ = run_segment(clone_state(state), seg0, seg_plan(state))
+    warm, _ = rematerialize_rewired(warm, cfg, cap)
+    warm2, _ = run_segment(warm, seg0, seg_plan(warm))
+    warm2, _ = rematerialize_rewired(warm2, cfg, cap)
+    last_seg = (total - int(state.round)) % r
+    if last_seg and total - int(state.round) > r:
+        warm2, _ = run_segment(warm2, last_seg, seg_plan(warm2))
+    float(warm2.coverage(0))  # fetch = completion barrier on axon
+    del warm, warm2
 
     t0 = _time.perf_counter()
     while int(state.round) < total:
         seg = min(r, total - int(state.round))
-        plan = seg_plan()
+        plan = seg_plan(state)
         if args.rounds > 0:
-            state, stats = simulate(state, cfg, seg, plan)
+            state, stats = run_segment(state, seg, plan)
             stats_parts.append(stats)
         else:
-            state = run_until_coverage(state, cfg, args.target, seg, plan=plan)
+            state, _ = run_segment(state, seg, plan)
             if float(state.coverage(0)) >= args.target:
                 break
         if int(state.round) < total:
@@ -344,12 +433,16 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
     stats_parts = []
 
     # warm the first segment outside the timed region (same static shapes)
+    # on a throwaway clone — the dist engines donate their state
+    from tpu_gossip.core.state import clone_state
+
     seg0 = min(r, total)
     if args.rounds > 0:
-        warm = simulate_dist(state, cfg, sg, mesh, seg0, plans)[0]
+        warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans)[0]
     else:
         warm = run_until_coverage_dist(
-            state, cfg, sg, mesh, args.target, seg0, shard_plan=plans
+            clone_state(state), cfg, sg, mesh, args.target, seg0,
+            shard_plan=plans,
         )
     float(warm.coverage(0))
     del warm
@@ -510,8 +603,8 @@ def _main_shard_matching(args, rng) -> int:
         else:
             result, fin = M.bench_swarm(
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
-                run=lambda: run_until_coverage_dist(
-                    state, cfg, plan, mesh, args.target, args.max_rounds
+                run=lambda st: run_until_coverage_dist(
+                    st, cfg, plan, mesh, args.target, args.max_rounds
                 ),
             )
             summary = {"summary": True, "mode": args.mode,
@@ -581,8 +674,8 @@ def _main_shard(args, graph, rng) -> int:
             # count, not the padded slot count
             result, fin = M.bench_swarm(
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
-                run=lambda: run_until_coverage_dist(
-                    state, cfg, sg, mesh, args.target, args.max_rounds,
+                run=lambda st: run_until_coverage_dist(
+                    st, cfg, sg, mesh, args.target, args.max_rounds,
                     shard_plan=plans,
                 ),
             )

@@ -42,6 +42,7 @@ __all__ = [
     "SwarmConfig",
     "SwarmState",
     "init_swarm",
+    "clone_state",
     "message_slot",
     "message_slots",
     "save_swarm",
@@ -192,6 +193,20 @@ def load_swarm(path) -> SwarmState:
     return SwarmState(**kwargs)
 
 
+def clone_state(state: SwarmState) -> SwarmState:
+    """Deep-copy every leaf (device-side, sharding preserved).
+
+    The jitted round entry points (``sim.engine.simulate`` /
+    ``run_until_coverage`` / ``rematerialize_rewired`` and the dist twins)
+    DONATE their state argument: the input buffers alias the outputs and
+    the caller's handles are deleted. Callers that need the input again —
+    benchmark repetitions, A/B trajectory comparisons, warm-up runs —
+    clone first and donate the clone. One O(state) device copy, paid
+    explicitly where the old engine paid it invisibly on every call.
+    """
+    return jax.tree.map(lambda leaf: leaf.copy(), state)
+
+
 def message_slot(message_id: int | str, msg_slots: int) -> int:
     """Map a message identity to its dedup slot (the "hash-based dedup" hash).
 
@@ -295,21 +310,35 @@ def init_swarm(
         infected_round = infected_round.at[origins, slots].set(0)
     if exists is None:
         exists = jnp.ones((n,), dtype=bool)
+
+    def owned(x, dtype=None):
+        """The state must OWN every leaf: the round entry points donate the
+        state pytree, and a leaf aliasing a caller array (a DeviceGraph's
+        CSR, a plan's ``exists`` mask, a reused PRNG key) would delete the
+        caller's array with it. ``jnp.asarray`` on an already-device array
+        of the right dtype is a no-copy identity — force the copy exactly
+        then; host arrays were copied to device by asarray anyway."""
+        arr = jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype=dtype)
+        return arr.copy() if arr is x else arr
+
+    exists = owned(exists)
     s = max(config.rewire_slots, 1)
     return SwarmState(
-        row_ptr=jnp.asarray(graph.row_ptr, dtype=jnp.int32),
-        col_idx=jnp.asarray(graph.col_idx, dtype=jnp.int32),
+        row_ptr=owned(graph.row_ptr, dtype=jnp.int32),
+        col_idx=owned(graph.col_idx, dtype=jnp.int32),
         seen=seen,
         forwarded=jnp.zeros((n, m), dtype=bool),
         infected_round=infected_round,
         recovered=jnp.zeros((n, m), dtype=bool),
         exists=exists,
-        alive=exists,
+        # a SEPARATE buffer from exists — two leaves sharing one buffer
+        # would confuse the donation aliasing
+        alive=exists.copy(),
         silent=jnp.zeros((n,), dtype=bool),
         last_hb=jnp.zeros((n,), dtype=jnp.int32),
         declared_dead=jnp.zeros((n,), dtype=bool),
         rewired=jnp.zeros((n,), dtype=bool),
         rewire_targets=jnp.zeros((n, s), dtype=jnp.int32),
-        rng=key,
+        rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

@@ -468,7 +468,14 @@ def repartition_swarm(
 
 def shard_swarm(state: SwarmState, mesh: Mesh) -> SwarmState:
     """Place per-peer arrays with a peer-axis NamedSharding (topology arrays
-    and scalars replicated)."""
+    and scalars replicated).
+
+    The output may ALIAS the input's buffers (``device_put`` reuses a
+    source buffer for the device it already lives on — always on a
+    1-device mesh, and for replicated leaves on any mesh). The dist round
+    entry points donate their state, so callers that keep using the
+    UNSHARDED original must shard a ``clone_state`` instead.
+    """
     peer = NamedSharding(mesh, P(AXIS))
     repl = NamedSharding(mesh, P())
     n_pad = state.alive.shape[0]
@@ -751,7 +758,11 @@ def gossip_round_dist(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "num_rounds"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh", "num_rounds"),
+    donate_argnames=("state",),
+)
 def simulate_dist(
     state: SwarmState,
     cfg: SwarmConfig,
@@ -760,7 +771,12 @@ def simulate_dist(
     num_rounds: int,
     shard_plan: ShardPlans | None = None,
 ) -> tuple[SwarmState, RoundStats]:
-    """Fixed-horizon multi-chip run (lax.scan), per-round stats history."""
+    """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
+
+    DONATES ``state`` like the local engine (sim/engine.py simulate): the
+    sharded per-peer buffers alias the output instead of being copied
+    every call — pass ``clone_state(state)`` to keep the input alive.
+    """
 
     def body(carry, _):
         nxt, stats = gossip_round_dist(carry, cfg, sg, mesh, shard_plan)
@@ -769,7 +785,11 @@ def simulate_dist(
     return jax.lax.scan(body, state, None, length=num_rounds)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_rounds", "slot"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh", "max_rounds", "slot"),
+    donate_argnames=("state",),
+)
 def run_until_coverage_dist(
     state: SwarmState,
     cfg: SwarmConfig,
@@ -780,7 +800,11 @@ def run_until_coverage_dist(
     slot: int = 0,
     shard_plan: ShardPlans | None = None,
 ) -> SwarmState:
-    """Multi-chip run-to-coverage (lax.while_loop, no host round-trips)."""
+    """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
+
+    DONATES ``state`` (see :func:`simulate_dist`); pass
+    ``clone_state(state)`` to keep the input alive.
+    """
 
     def cond(st: SwarmState) -> jax.Array:
         return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)

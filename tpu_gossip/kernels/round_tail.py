@@ -1,0 +1,309 @@
+"""Fused protocol tail: every post-delivery slot-array pass in ONE traversal.
+
+After dissemination, the round still owes dedup merge (``seen |=
+incoming``), first-infection latching (``infected_round``), per-slot SIR
+recovery, forward-once bookkeeping, and the churn fresh-slot resets — five
+logical passes over the (N, M) slot arrays. At 1M peers the delivery stage
+is ~1.4 ms while the composed round is ~14.4 ms (VERDICT r5 item 7): the
+protocol tail dominates ~10×, and its binding resource is HBM traffic over
+the slot arrays (``infected_round`` alone is 64 MB at 1M×16), not compute.
+
+This module states the tail ONCE as a single traversal and provides three
+implementations that are **bit-identical by construction** (boolean algebra
+and int32 selects only — no floats, nothing rounds):
+
+- :func:`tail_reference` — a literal transcription of the historical
+  ``advance_round`` pass sequence (merge, latch, SIR, then fresh masks as a
+  second sweep). Kept as the bitwise ORACLE the fused paths are tested
+  against (tests/sim/test_round_tail.py), and available via
+  ``gossip_round(..., tail="reference")``.
+- :func:`tail_fused` — the same function as one dependency chain with each
+  output materialized exactly once (the churn fresh mask folded into the
+  producing expression instead of a second sweep), so XLA emits one fused
+  loop reading every input once: the ``lax``-fused path, the default on
+  every engine and backend.
+- :func:`tail_pallas` — the same math as one Pallas launch over row blocks:
+  each grid step streams a (block_rows, M) window of every operand through
+  VMEM and writes all four outputs, so the whole tail is a single kernel
+  with no XLA fusion-boundary re-reads. Opt-in
+  (``gossip_round(..., tail="pallas")``, ``run_sim --tail pallas``) until a
+  hardware A/B picks the default: this container is CPU-only, so the kernel
+  is conformance-tested in interpret mode and the TPU decision rides the
+  next hardware bench (docs/round_tail_profile.md).
+
+Because every implementation is exact over bools/int32, choosing any of
+them preserves the local↔sharded bit-identity contract
+(tests/sim/test_dist.py::test_matching_dist_bit_identical_to_single_chip):
+the dist engines share :func:`round_tail` through
+``sim.engine.advance_round``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = [
+    "TAIL_IMPLS",
+    "round_tail",
+    "tail_reference",
+    "tail_fused",
+    "tail_pallas",
+]
+
+TAIL_IMPLS = ("fused", "reference", "pallas")
+
+# rows per Pallas grid step: bounds VMEM residency to ~block_rows * M words
+# per operand while keeping the sequential grid short (1M rows / 512 = ~2k
+# steps). The slot dim rides the lane axis as-is (M=16 underfills the
+# 128-lane VPU); the kernel is HBM-bound, so the single launch — one read
+# and one write per operand — is the win, not lane occupancy.
+BLOCK_ROWS = 512
+
+
+def _fresh_col(fresh: jax.Array | None) -> jax.Array | None:
+    return None if fresh is None else fresh[:, None]
+
+
+def tail_reference(
+    seen: jax.Array,
+    forwarded: jax.Array,
+    infected_round: jax.Array,
+    recovered: jax.Array,
+    incoming: jax.Array,
+    receptive: jax.Array,
+    transmit: jax.Array,
+    fresh: jax.Array | None,
+    rnd: jax.Array,
+    *,
+    forward_once: bool,
+    sir_recover_rounds: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The historical pass sequence, verbatim — the bitwise oracle.
+
+    Merge/latch/SIR first, then (when a churn rejoin fired) the fresh-slot
+    resets as a SECOND sweep over the just-produced arrays — exactly the
+    order ``advance_round`` used before the fusion, so regressions in the
+    fused paths are caught against the original semantics, not against
+    themselves.
+    """
+    inc = incoming & receptive
+    new_seen = seen | inc
+    new_fwd = (forwarded | transmit) if forward_once else forwarded
+    newly = inc & ~seen
+    new_ir = jnp.where(newly & (infected_round < 0), rnd, infected_round)
+    new_rec = recovered
+    if sir_recover_rounds > 0:
+        new_rec = recovered | (
+            (new_ir >= 0) & (rnd - new_ir >= sir_recover_rounds)
+        )
+    if fresh is not None:
+        fc = _fresh_col(fresh)
+        new_seen = new_seen & ~fc
+        new_fwd = new_fwd & ~fc
+        new_ir = jnp.where(fc, -1, new_ir)
+        new_rec = new_rec & ~fc
+    return new_seen, new_fwd, new_ir, new_rec
+
+
+def tail_fused(
+    seen: jax.Array,
+    forwarded: jax.Array,
+    infected_round: jax.Array,
+    recovered: jax.Array,
+    incoming: jax.Array,
+    receptive: jax.Array,
+    transmit: jax.Array,
+    fresh: jax.Array | None,
+    rnd: jax.Array,
+    *,
+    forward_once: bool,
+    sir_recover_rounds: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Single-traversal form: each output is one expression, materialized
+    once, with the fresh mask folded into the producing select instead of a
+    second sweep. Bitwise-equal to :func:`tail_reference` (pure boolean
+    algebra: ``(a | b) & ~f`` has one value however it is scheduled)."""
+    fc = _fresh_col(fresh)
+    inc = incoming & receptive
+    keep = None if fc is None else ~fc
+    new_seen = (seen | inc) if keep is None else ((seen | inc) & keep)
+    if forward_once:
+        new_fwd = (forwarded | transmit) if keep is None else (
+            (forwarded | transmit) & keep
+        )
+    else:
+        new_fwd = forwarded if keep is None else (forwarded & keep)
+    latch = (inc & ~seen) & (infected_round < 0)
+    new_ir = jnp.where(latch, rnd, infected_round)
+    if sir_recover_rounds > 0:
+        new_rec = recovered | (
+            (new_ir >= 0) & (rnd - new_ir >= sir_recover_rounds)
+        )
+    else:
+        new_rec = recovered
+    if fc is not None:
+        new_ir = jnp.where(fc, -1, new_ir)
+        new_rec = new_rec & keep
+    return new_seen, new_fwd, new_ir, new_rec
+
+
+def _tail_kernel(forward_once: bool, sir: int, has_fresh: bool):
+    """One grid step: the whole tail over a (block_rows, M) row window."""
+
+    def kernel(*refs):
+        it = iter(refs)
+        seen_ref = next(it)
+        ir_ref = next(it)
+        rec_ref = next(it)
+        inc_ref = next(it)
+        recp_ref = next(it)
+        fwd_ref = next(it) if (forward_once or has_fresh) else None
+        tx_ref = next(it) if forward_once else None
+        fresh_ref = next(it) if has_fresh else None
+        rnd_ref = next(it)
+        o_seen = next(it)
+        o_ir = next(it)
+        o_rec = next(it)
+        o_fwd = next(it) if (forward_once or has_fresh) else None
+
+        rnd = rnd_ref[0, 0]
+        seen = seen_ref[...]
+        inc = inc_ref[...] & recp_ref[...]
+        keep = None
+        if has_fresh:
+            keep = ~fresh_ref[...]  # (blk, 1) broadcasts over the slot dim
+        new_seen = seen | inc
+        if keep is not None:
+            new_seen = new_seen & keep
+        o_seen[...] = new_seen
+
+        ir = ir_ref[...]
+        new_ir = jnp.where((inc & ~seen) & (ir < 0), rnd, ir)
+        rec = rec_ref[...]
+        if sir > 0:
+            rec = rec | ((new_ir >= 0) & (rnd - new_ir >= sir))
+        if has_fresh:
+            new_ir = jnp.where(fresh_ref[...], -1, new_ir)
+            rec = rec & keep
+        o_ir[...] = new_ir
+        o_rec[...] = rec
+
+        if o_fwd is not None:
+            fwd = fwd_ref[...]
+            if forward_once:
+                fwd = fwd | tx_ref[...]
+            if keep is not None:
+                fwd = fwd & keep
+            o_fwd[...] = fwd
+
+    return kernel
+
+
+def tail_pallas(
+    seen: jax.Array,
+    forwarded: jax.Array,
+    infected_round: jax.Array,
+    recovered: jax.Array,
+    incoming: jax.Array,
+    receptive: jax.Array,
+    transmit: jax.Array,
+    fresh: jax.Array | None,
+    rnd: jax.Array,
+    *,
+    forward_once: bool,
+    sir_recover_rounds: int,
+    interpret: bool | None = None,
+    block_rows: int = BLOCK_ROWS,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The tail as ONE Pallas launch over row blocks (same math, same bits).
+
+    When neither forward-once nor a churn rejoin touches ``forwarded``, the
+    kernel skips it entirely and the input passes through untouched — the
+    common headline configuration moves three outputs, not four.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    n, m = seen.shape
+    has_fresh = fresh is not None
+    needs_fwd = forward_once or has_fresh
+    blk = min(block_rows, n)
+    grid = (-(-n // blk),)
+
+    row_spec = pl.BlockSpec((blk, m), lambda i: (i, 0))
+    one_spec = pl.BlockSpec((blk, 1), lambda i: (i, 0))
+    rnd_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+
+    args = [seen, infected_round, recovered, incoming, receptive]
+    in_specs = [row_spec] * 5
+    if needs_fwd:
+        args.append(forwarded)
+        in_specs.append(row_spec)
+    if forward_once:
+        args.append(transmit)
+        in_specs.append(row_spec)
+    if has_fresh:
+        args.append(fresh[:, None])
+        in_specs.append(one_spec)
+    args.append(jnp.asarray(rnd, jnp.int32).reshape(1, 1))
+    in_specs.append(rnd_spec)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((n, m), jnp.bool_),  # seen
+        jax.ShapeDtypeStruct((n, m), jnp.int32),  # infected_round
+        jax.ShapeDtypeStruct((n, m), jnp.bool_),  # recovered
+    ]
+    out_specs = [row_spec, row_spec, row_spec]
+    if needs_fwd:
+        out_shape.append(jax.ShapeDtypeStruct((n, m), jnp.bool_))
+        out_specs.append(row_spec)
+
+    outs = pl.pallas_call(
+        _tail_kernel(forward_once, sir_recover_rounds, has_fresh),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    new_seen, new_ir, new_rec = outs[0], outs[1], outs[2]
+    new_fwd = outs[3] if needs_fwd else forwarded
+    return new_seen, new_fwd, new_ir, new_rec
+
+
+def round_tail(
+    seen: jax.Array,
+    forwarded: jax.Array,
+    infected_round: jax.Array,
+    recovered: jax.Array,
+    incoming: jax.Array,
+    receptive: jax.Array,
+    transmit: jax.Array,
+    fresh: jax.Array | None,
+    rnd: jax.Array,
+    *,
+    forward_once: bool,
+    sir_recover_rounds: int,
+    impl: str = "fused",
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Dispatch to one of the three bit-identical tail implementations.
+
+    Returns ``(seen, forwarded, infected_round, recovered)``. ``fresh``
+    (N,) bool marks slots a churn rejoin reset this round (None = no join
+    configured — the masks compile away entirely).
+    """
+    if impl not in TAIL_IMPLS:
+        raise ValueError(f"unknown tail impl {impl!r}; choose from {TAIL_IMPLS}")
+    kw = dict(forward_once=forward_once, sir_recover_rounds=sir_recover_rounds)
+    if impl == "pallas":
+        return tail_pallas(
+            seen, forwarded, infected_round, recovered, incoming, receptive,
+            transmit, fresh, rnd, interpret=interpret, **kw,
+        )
+    fn = tail_reference if impl == "reference" else tail_fused
+    return fn(
+        seen, forwarded, infected_round, recovered, incoming, receptive,
+        transmit, fresh, rnd, **kw,
+    )

@@ -71,6 +71,7 @@ def bench_swarm(
     plan=None,
     run=None,
     n_peers: int | None = None,
+    tail: str = "fused",
 ) -> tuple[BenchResult, SwarmState]:
     """Time the run-to-coverage while_loop on device (compile excluded).
 
@@ -79,30 +80,53 @@ def bench_swarm(
     variance) and the actual final state, so callers can checkpoint what was
     measured.
 
-    ``run`` swaps in a different zero-arg run-to-coverage callable (the
-    sharded engine's ``run_until_coverage_dist``, a custom horizon) while
-    keeping THIS timing harness — warmup, scalar-fetch completion barrier,
-    min-over-reps — in exactly one place. ``n_peers`` overrides the
-    reported swarm size (e.g. the real peer count when ``cfg.n_peers`` is
-    a padded slot count).
+    The round entry points DONATE their state (sim/engine.py), so every
+    repetition runs on a fresh ``clone_state`` of ``state``, cloned BEFORE
+    the timer starts — the measured region is the pure donated run, with no
+    hidden input copy, and the caller's ``state`` survives the benchmark.
+
+    ``run`` swaps in a different run-to-coverage callable (the sharded
+    engine's ``run_until_coverage_dist``, a custom horizon) while keeping
+    THIS timing harness — warmup, per-rep clone, scalar-fetch completion
+    barrier, min-over-reps — in exactly one place. It must accept the
+    (already-cloned, donatable) state as its ONE argument and return the
+    final state; a zero-arg callable (the pre-donation API) is rejected
+    loudly — it would close over a state the first call deletes.
+    ``n_peers`` overrides the reported swarm size (e.g. the real peer count
+    when ``cfg.n_peers`` is a padded slot count). ``tail`` selects the
+    protocol-tail implementation for the default runner (A/B hook for
+    kernels/round_tail.py; ignored with a custom ``run``).
     """
+    from tpu_gossip.core.state import clone_state
+
     if run is not None and plan is not None:
         raise ValueError(
             "bench_swarm: pass plan= only with the default runner — a "
             "custom run= callable closes over its own delivery plan and "
             "the plan argument would be silently ignored"
         )
-    if run is None:
-        run = lambda: run_until_coverage(  # noqa: E731
-            state, cfg, target, max_rounds, plan=plan)
+    if run is not None:
+        import inspect
+
+        if not inspect.signature(run).parameters:
+            raise TypeError(
+                "bench_swarm: run= must accept the state to run on "
+                "(run(state) -> final_state) — the engines donate their "
+                "state, so a zero-arg runner would re-donate a deleted "
+                "closure state on the second repetition"
+            )
+    else:
+        run = lambda st: run_until_coverage(  # noqa: E731
+            st, cfg, target, max_rounds, plan=plan, tail=tail)
     n = cfg.n_peers if n_peers is None else n_peers
     if warmup:
-        float(run().coverage(0))
+        float(run(clone_state(state)).coverage(0))
     best = None
     fin = state
     for _ in range(max(reps, 1)):
+        rep_state = clone_state(state)  # outside the timed region
         t0 = time.perf_counter()
-        fin = run()
+        fin = run(rep_state)
         # host-fetch a scalar inside the timed region: on some platforms
         # (axon tunnel) block_until_ready returns before execution
         # completes, so the fetch is the only reliable completion barrier
@@ -144,7 +168,8 @@ def write_jsonl(stats: RoundStats, sink: IO[str]) -> None:
 def run_with_metrics(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, sink: IO[str] | None = None
 ) -> tuple[SwarmState, RoundStats]:
-    """simulate() + optional JSONL emission."""
+    """simulate() + optional JSONL emission. DONATES ``state`` (simulate
+    does); thread the returned state or pass a ``clone_state``."""
     fin, stats = simulate(state, cfg, num_rounds)
     if sink is not None:
         write_jsonl(stats, sink)

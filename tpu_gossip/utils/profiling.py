@@ -1,20 +1,38 @@
-"""Profiler tracing hook (SURVEY.md §5.1).
+"""Profiler tracing hook + per-stage round decomposition (SURVEY.md §5.1).
 
 The reference's only visibility into runtime behavior is timestamped log
 lines (reference Peer.py:40-49, Seed.py:78-87) — "log-line archaeology".
-The TPU-native replacement is a real device trace: wrap any region (a bench
-run, a simulate() horizon) in :func:`trace` and XLA records per-op device
-timelines viewable in TensorBoard / Perfetto (`xprof`). Exposed as
-``--profile DIR`` on ``bench.py`` and ``cli/run_sim.py``.
+The TPU-native replacement is two tools:
+
+- :func:`trace` — a real device trace: wrap any region (a bench run, a
+  simulate() horizon) and XLA records per-op device timelines viewable in
+  TensorBoard / Perfetto (`xprof`). Exposed as ``--profile DIR`` on
+  ``bench.py`` and ``cli/run_sim.py``.
+- :func:`profile_round_stages` — a slope-timed decomposition of ONE
+  composed gossip round into its stages (delivery, the protocol tail per
+  implementation, liveness, stats, RNG) using the two-point fori_loop
+  method bench.py's hardware ceilings use: time the same on-device loop at
+  two iteration counts and divide the difference, so constant
+  dispatch+fetch latency cancels. Exposed as ``--profile-round`` on
+  ``cli/run_sim.py``; the published table lives in
+  docs/round_tail_profile.md. Every stage body folds its outputs into an
+  int32 carry (keeps the work live against DCE) — all stages pay that one
+  reduction, so relative comparisons are fair.
 """
 
 from __future__ import annotations
 
 import contextlib
+import time
 from pathlib import Path
 from typing import Iterator
 
-__all__ = ["trace"]
+__all__ = [
+    "trace",
+    "slope_time",
+    "profile_round_stages",
+    "format_stage_table",
+]
 
 
 @contextlib.contextmanager
@@ -38,3 +56,191 @@ def trace(log_dir: str | Path | None) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def slope_time(body, carry, n1: int, n2: int, reps: int = 3, operands=()) -> float:
+    """Per-iteration seconds of an on-device ``fori_loop`` body.
+
+    Two-point slope: run the loop at ``n1`` and ``n2`` iterations and
+    divide the wall delta by ``n2 - n1`` — the constant per-dispatch +
+    result-fetch latency cancels exactly (the method bench.py's hardware
+    ceilings use). ``body(i, carry, *operands) -> carry``; the first leaf
+    of the final carry is host-fetched as the completion barrier. Min wall
+    over ``reps``. Returns NaN when noise wins (non-positive slope).
+
+    Pass the body's large device arrays via ``operands`` (a pytree), NOT as
+    closure captures: a closed-over concrete array becomes an XLA CONSTANT
+    in the traced loop, and XLA's compile-time constant folding then
+    evaluates whole (N, M)-sized expressions op by op — tens of seconds of
+    compile per stage at 1M, for numbers that measure the folder instead of
+    the program. Operands are jit arguments, so they stay runtime inputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run(iters: int) -> float:
+        @jax.jit
+        def f(c, ops):
+            return jax.lax.fori_loop(
+                0, iters, lambda i, cc: body(i, cc, *ops), c
+            )
+
+        out = f(carry, operands)
+        _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))  # warm + barrier
+        best = float("inf")
+        for _rep in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            out = f(carry, operands)
+            _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    dt = (run(n2) - run(n1)) / (n2 - n1)
+    return dt if dt > 0 else float("nan")
+
+
+def profile_round_stages(
+    state,
+    cfg,
+    plan=None,
+    *,
+    reps: int = 3,
+    loop_lengths: tuple[int, int] = (4, 24),
+    tails: tuple[str, ...] = ("reference", "fused"),
+) -> dict[str, float]:
+    """Stage decomposition of one composed round, in seconds per round.
+
+    Stages (each an independent slope measurement on the SAME state —
+    pre-run a few rounds first so slot densities are mid-epidemic):
+
+    - ``delivery``            — the dissemination stage alone
+      (``_disseminate_local`` with the given plan), fresh key per iter
+    - ``tail[<impl>]``        — the fused/reference/pallas protocol tail
+      (kernels/round_tail.py) over one delivery's ``incoming``
+    - ``liveness``            — heartbeat emission + failure-detector sweep
+    - ``stats``               — the per-round RoundStats reductions
+    - ``rng``                 — the round's key splits
+    - ``full_round[<impl>]``  — the composed ``gossip_round`` per tail
+
+    ``tails`` picks the tail implementations measured (add "pallas" for the
+    single-launch kernel — interpret-mode on CPU, so only meaningful on
+    TPU). Stage sums need not equal the full round: XLA fuses across stage
+    boundaries inside the composed round; the decomposition bounds each
+    stage's isolated cost, the composed rows measure reality.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_gossip.kernels.round_tail import round_tail
+    from tpu_gossip.sim import engine
+
+    n1, n2 = loop_lengths
+    _, transmitter, receptive = engine.compute_roles(state)
+    transmit = engine.transmit_bitmap(state, cfg, transmitter)
+
+    @jax.jit
+    def one_delivery(key, st, tx, tr, rc, pl):
+        k_push, k_pull = jax.random.split(key)
+        return engine._disseminate_local(st, cfg, tx, tr, rc, k_push, k_pull, pl)
+
+    incoming, _ = one_delivery(
+        jax.random.key(17), state, transmit, transmitter, receptive, plan
+    )
+    fresh = None
+    if cfg.churn_join_prob > 0.0:
+        # a plausibly-dense fresh mask (the tail's churn-reset operand):
+        # Bernoulli(join_prob) over existing slots, like a real join draw
+        k_fresh = jax.random.key(23)
+        fresh = state.exists & (
+            jax.random.uniform(k_fresh, state.alive.shape)
+            < cfg.churn_join_prob
+        )
+
+    def fold(c, *arrays):
+        for a in arrays:
+            c = c ^ jnp.sum(a, dtype=jnp.int32)
+        return c
+
+    # every stage body receives its device arrays as slope_time OPERANDS —
+    # closure-captured arrays would become XLA constants and melt compile
+    # time into constant folding (see slope_time's docstring)
+    def t_delivery(i, c, st, tx, tr, rc, pl):
+        inc, msgs = one_delivery(
+            jax.random.fold_in(jax.random.key(1), i), st, tx, tr, rc, pl
+        )
+        return fold(c, inc, msgs)
+
+    def tail_body(impl):
+        def body(i, c, st, inc, rc, tx, fr):
+            seen, fwd, ir, rec = round_tail(
+                st.seen, st.forwarded, st.infected_round, st.recovered,
+                inc, rc, tx, fr, i,
+                forward_once=cfg.forward_once,
+                sir_recover_rounds=cfg.sir_recover_rounds, impl=impl,
+            )
+            return fold(c, seen, fwd, ir, rec)
+
+        return body
+
+    def t_liveness(i, c, st):
+        from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
+
+        hb = emit_heartbeats(
+            st.last_hb, st.alive, st.silent, st.declared_dead,
+            i, cfg.hb_period_rounds,
+        )
+        hb, dead = detect_failures(
+            hb, st.alive, st.silent, st.declared_dead,
+            i, cfg.timeout_rounds, cfg.detect_period_rounds,
+        )
+        return fold(c, hb, dead)
+
+    def t_stats(i, c, st):
+        stats = engine._stats(st, i)
+        return fold(c, stats.msgs_sent, stats.n_infected, stats.n_alive) ^ (
+            stats.coverage > 0.5
+        ).astype(jnp.int32)
+
+    def t_rng(i, c):
+        keys = jax.random.split(jax.random.fold_in(jax.random.key(2), i), 5)
+        return fold(c, jax.random.key_data(keys)[..., 0].astype(jnp.int32))
+
+    def round_body(impl):
+        def body(i, s, pl):
+            nxt, _ = engine.gossip_round(s, cfg, pl, tail=impl)
+            return nxt
+
+        return body
+
+    zero = jnp.int32(0)
+    deliver_ops = (state, transmit, transmitter, receptive, plan)
+    tail_ops = (state, incoming, receptive, transmit, fresh)
+    stages: dict[str, float] = {}
+    stages["delivery"] = slope_time(
+        t_delivery, zero, n1, n2, reps, operands=deliver_ops
+    )
+    for impl in tails:
+        stages[f"tail[{impl}]"] = slope_time(
+            tail_body(impl), zero, n1, n2, reps, operands=tail_ops
+        )
+    stages["liveness"] = slope_time(
+        t_liveness, zero, n1, n2, reps, operands=(state,)
+    )
+    stages["stats"] = slope_time(t_stats, zero, n1, n2, reps, operands=(state,))
+    stages["rng"] = slope_time(t_rng, zero, n1, n2, reps)
+    for impl in tails:
+        stages[f"full_round[{impl}]"] = slope_time(
+            round_body(impl), state, n1, n2, reps, operands=(plan,)
+        )
+    return stages
+
+
+def format_stage_table(stages: dict[str, float]) -> str:
+    """The stage dict as a markdown table (ms per round), in the profiler's
+    emission order — decomposition stages first, composed rounds last (the
+    docs/round_tail_profile.md row format)."""
+    lines = ["| stage | ms/round |", "|---|---|"]
+    for name, secs in stages.items():
+        ms = secs * 1e3
+        lines.append(f"| {name} | {ms:.3f} |")
+    return "\n".join(lines)
