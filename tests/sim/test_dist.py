@@ -500,3 +500,165 @@ def test_sharding_layout(setup, mode, extra, kernel):
             if "peers" not in spec:
                 bad[f] = spec
     assert not bad, f"state leaves lost the peer sharding: {bad}"
+
+
+# --- chaos scenarios on the mesh (faults/: the bit-identity extension) ----
+
+
+def _chaos_spec(heal=4):
+    """Loss + delay + split-brain + churn burst + blackout across three
+    phases — every fault class the scenario engine injects."""
+    from tpu_gossip.faults import scenario_from_dict
+
+    return scenario_from_dict({"name": "chaos", "phases": [
+        {"name": "lossy", "start": 0, "end": 2, "loss": 0.3, "delay": 0.3},
+        {"name": "split", "start": 2, "end": heal, "partition": "half",
+         "loss": 0.1},
+        {"name": "storm", "start": heal, "end": heal + 2,
+         "churn_leave": 0.1, "churn_join": 0.3,
+         "blackout": {"frac": 0.1, "seed": 9}},
+    ]})
+
+
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("push_pull", {}),
+        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                           rewire_slots=2)),
+        ("flood", {}),
+    ],
+    ids=["push_pull", "push_pull_churn", "flood"],
+)
+def test_matching_dist_scenario_bit_identical(matching_setup, mode, extra):
+    """THE acceptance criterion: a mesh round under an active scenario
+    (loss + delay + partition + churn burst + blackout) is bit-identical
+    to the local round — fault draws are made at global shape from the
+    derived fault stream, the two-pass partition delivery wraps the same
+    dissemination core on both engines."""
+    from tpu_gossip.faults import compile_scenario
+
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode=mode, **extra)
+    st = _matching_state(g, cfg)
+
+    def rows_of(ids):
+        ids = np.asarray(ids)
+        return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+    sc = compile_scenario(
+        _chaos_spec(), n_peers=1500, n_slots=plan.n, total_rounds=8,
+        node_map=rows_of,
+    )
+    fin_l, stats_l = simulate(clone_state(st), cfg, 6, plan, "fused", sc)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 6, None, sc
+    )
+    for f in ("seen", "alive", "rewired", "declared_dead", "recovered",
+              "last_hb", "rewire_targets", "fault_held"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fin_l, f)), np.asarray(getattr(fin_d, f)),
+            err_msg=f,
+        )
+    for f in ("msgs_sent", "msgs_dropped", "msgs_held", "msgs_delivered",
+              "coverage"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(stats_l, f)), np.asarray(getattr(stats_d, f)),
+            err_msg=f,
+        )
+    # the scenario must actually bite, or the parity is vacuous
+    assert np.asarray(stats_l.msgs_dropped).sum() > 0
+    assert np.asarray(stats_l.msgs_held).max() > 0
+
+
+def test_bucketed_scenario_flood_parity_with_single_device(setup):
+    """Flood is deterministic, so the bucketed mesh under a scenario must
+    match the single-device engine bit for bit — loss/delay draws land at
+    identical stream positions on both."""
+    from tpu_gossip.faults import compile_scenario
+
+    _, mesh, sg, relabeled, position = setup
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, mode="flood")
+    sc = compile_scenario(
+        _chaos_spec(), n_peers=N, n_slots=sg.n_pad, total_rounds=8,
+        node_map=lambda ids: position[np.asarray(ids)],
+    )
+    st_d = shard_swarm(init_sharded_swarm(sg, relabeled, position, cfg, origins=[0]), mesh)
+    st_l = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
+    fin_d, stats_d = simulate_dist(st_d, cfg, sg, mesh, 6, None, sc)
+    fin_l, stats_l = simulate(st_l, cfg, 6, None, "fused", sc)
+    np.testing.assert_array_equal(np.asarray(fin_d.seen), np.asarray(fin_l.seen))
+    np.testing.assert_array_equal(
+        np.asarray(fin_d.fault_held), np.asarray(fin_l.fault_held)
+    )
+    for f in ("coverage", "msgs_dropped", "msgs_held", "msgs_delivered"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(stats_d, f)), np.asarray(getattr(stats_l, f)),
+            err_msg=f,
+        )
+
+
+def test_bucketed_scenario_kernel_receive_parity(setup):
+    """The staircase-kernel receive path under an active scenario stays
+    bit-identical to the scatter receive — the fault stage wraps the
+    dissemination core ABOVE the receive-side choice."""
+    from tpu_gossip.faults import compile_scenario
+
+    _, mesh, sg, relabeled, position = setup
+    plans = build_shard_plans(sg)
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    sc = compile_scenario(
+        _chaos_spec(), n_peers=N, n_slots=sg.n_pad, total_rounds=8,
+        node_map=lambda ids: position[np.asarray(ids)],
+    )
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0, 1],
+                           key=jax.random.key(3)), mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 6, None, sc)
+    fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 6, plans, sc)
+    np.testing.assert_array_equal(np.asarray(fin_a.seen), np.asarray(fin_b.seen))
+    np.testing.assert_array_equal(
+        np.asarray(stats_a.msgs_sent), np.asarray(stats_b.msgs_sent)
+    )
+    for f in ("alive", "declared_dead", "fault_held"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fin_a, f)), np.asarray(getattr(fin_b, f)),
+            err_msg=f,
+        )
+
+
+def test_split_brain_heals_on_the_mesh(matching_setup):
+    """The acceptance scenario end-to-end on the mesh: coverage stalls at
+    the partition boundary, then recovers past 99% after heal."""
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+    from tpu_gossip.sim.metrics import recoverage_rounds
+
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode="push_pull")
+    st = _matching_state(g, cfg, origins=(0,))
+    heal = 10
+
+    def rows_of(ids):
+        ids = np.asarray(ids)
+        return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+    spec = scenario_from_dict({"phases": [
+        {"name": "split", "start": 0, "end": heal, "partition": "half"},
+    ]})
+    sc = compile_scenario(spec, n_peers=1500, n_slots=plan.n,
+                          total_rounds=40, node_map=rows_of)
+    fin, stats = simulate_dist(shard_swarm(st, mesh), cfg, plan_m, mesh, 30,
+                               None, sc)
+    cov = np.asarray(stats.coverage)
+    group_b = np.asarray(sc.group_b)[0]
+    exists = np.asarray(g.exists)
+    share = (exists & ~group_b).sum() / exists.sum()
+    assert (cov[:heal] <= share + 1e-6).all(), "traffic crossed the partition"
+    # the erased configuration model leaves a ~1.5% unreachable tail at
+    # this size, so "99%" is of the ACHIEVABLE ceiling (the no-fault
+    # engine tests saturate at the same cov[-1] plateau)
+    ceiling = cov[-1]
+    assert ceiling > 0.95, f"epidemic never recovered (final {ceiling})"
+    rec = recoverage_rounds(stats, heal, 0.99 * ceiling)
+    assert 0 < rec <= 18, f"mesh re-coverage took {rec} rounds"

@@ -50,6 +50,7 @@ def test_jsonl_rows(setup):
     assert rows[0]["round"] == 1
     assert set(rows[0]) == {
         "round", "coverage", "msgs_sent", "n_infected", "n_alive", "n_declared_dead",
+        "msgs_dropped", "msgs_held", "msgs_delivered",
     }
 
 

@@ -116,6 +116,28 @@ def _ctx():
     }
 
 
+def _chaos_scenario(n_slots: int, n_real: int):
+    """A non-trivial compiled scenario — every fault class active (loss,
+    delay, partition, blackout, churn burst) — so the scenario-threaded
+    round traces its full structure (two-pass delivery, held buffer,
+    burst churn) under the fixed-point contract."""
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+
+    spec = scenario_from_dict({
+        "name": "audit-chaos",
+        "phases": [
+            {"name": "lossy", "start": 0, "end": 2, "loss": 0.2,
+             "delay": 0.2},
+            {"name": "split", "start": 2, "end": 4, "partition": "half"},
+            {"name": "storm", "start": 4, "end": 6, "churn_leave": 0.05,
+             "churn_join": 0.2, "blackout": {"frac": 0.1, "seed": 1}},
+        ],
+    })
+    return compile_scenario(
+        spec, n_peers=n_real, n_slots=n_slots, total_rounds=8
+    )
+
+
 def _stats_contract(stats, problems: list, leading=()) -> None:
     import jax.numpy as jnp
 
@@ -125,6 +147,9 @@ def _stats_contract(stats, problems: list, leading=()) -> None:
         "n_infected": jnp.int32,
         "n_alive": jnp.int32,
         "n_declared_dead": jnp.int32,
+        "msgs_dropped": jnp.int32,
+        "msgs_held": jnp.int32,
+        "msgs_delivered": jnp.int32,
     }
     for field, dt in declared.items():
         leaf = getattr(stats, field, None)
@@ -280,6 +305,37 @@ def _check_gossip_round() -> list:
         try:
             out_st, out_stats = jax.eval_shape(
                 lambda s, t=tail: engine.gossip_round(s, cfg, tail=t), st
+            )
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
+            continue
+        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
+        _stats_contract(out_stats, problems)
+    # chaos scenarios (faults/): a round with every fault class active —
+    # two-pass partition delivery, the delay buffer, blackout masks, burst
+    # churn — must still be a state fixed point on every delivery engine,
+    # or a scenario could never ride a scan/while carry
+    scen = _chaos_scenario(
+        ctx["dg"].n_pad, _N_DEV
+    )
+    for graph, plan, label in (
+        (ctx["dg"], None, "xla"),
+        (ctx["mg"], ctx["mplan"], "matching"),
+    ):
+        scen_g = scen if graph is ctx["dg"] else _chaos_scenario(
+            graph.n_pad, _N_MATCH
+        )
+        st, cfg = ctx["state_for"](
+            graph, 16, mode="push_pull", rewire_slots=2,
+            churn_join_prob=0.02, churn_leave_prob=0.002,
+        )
+        name = f"gossip_round[scenario,{label}]"
+        try:
+            out_st, out_stats = jax.eval_shape(
+                lambda s, p=plan, sc=scen_g: engine.gossip_round(
+                    s, cfg, p, scenario=sc
+                ),
+                st,
             )
         except Exception as e:  # noqa: BLE001
             problems.append(f"{name}: abstract eval failed: {e!r:.200}")
@@ -443,6 +499,27 @@ def _check_dist() -> list:
     except Exception as e:  # noqa: BLE001
         problems.append(
             f"gossip_round_dist[matching]: abstract eval failed: {e!r:.200}"
+        )
+    # the mesh round under an active chaos scenario (faults/) — the
+    # bit-identity contract's distributed half must trace with the same
+    # fixed point the local scenario round keeps
+    scen = _chaos_scenario(plan.n, _N_MATCH)
+    try:
+        out_st, out_stats = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(
+                s, cfg, plan, mesh, scenario=scen
+            ),
+            st,
+        )
+        _diff_specs(
+            "gossip_round_dist[matching,scenario]",
+            _spec_tree(out_st), _spec_tree(st), problems,
+        )
+        _stats_contract(out_stats, problems)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[matching,scenario]: abstract eval failed: "
+            f"{e!r:.200}"
         )
     # bucketed-CSR engine over a partitioned host graph
     import numpy as np

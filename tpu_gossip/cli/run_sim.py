@@ -105,6 +105,16 @@ def build_parser() -> argparse.ArgumentParser:
         "JSON. Local engine only; the published table lives in "
         "docs/round_tail_profile.md",
     )
+    p.add_argument(
+        "--scenario", type=str, default="", metavar="TOML",
+        help="chaos scenario schedule (tpu_gossip/faults/, docs/"
+        "fault_model.md): time-phased message loss, delivery delay, "
+        "split-brain partitions, node/shard blackouts, churn bursts — "
+        "injected deterministically from a dedicated PRNG stream on every "
+        "engine (local and sharded rounds stay bit-identical). The "
+        "schedule is validated BEFORE the run: phases beyond --rounds/"
+        "--max-rounds or overlapping phases are config errors",
+    )
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
     p.add_argument(
@@ -126,6 +136,36 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_gossip.sim.engine import simulate
 
     rng = np.random.default_rng(args.seed)
+    spec = None
+    if args.scenario:
+        from tpu_gossip.faults import ScenarioError, parse_scenario
+
+        try:
+            spec = parse_scenario(args.scenario)
+            # reject impossible schedules BEFORE building anything: phases
+            # naming rounds the run can never reach, overlapping phases,
+            # bad node sets — a config error, not a silent mid-run no-op
+            spec.validate(
+                total_rounds=args.rounds if args.rounds > 0 else args.max_rounds,
+                n_peers=args.peers,
+                n_shards=len(jax.devices()) if args.shard else None,
+            )
+        except (ScenarioError, OSError) as e:
+            # OSError: a typo'd path is as much a config error as a bad
+            # schedule — same clean rejection, no traceback
+            print(f"--scenario: {e}", file=sys.stderr)
+            return 2
+        if args.profile_round > 0:
+            print("--profile-round measures the fault-free round's stage "
+                  "decomposition; drop --scenario", file=sys.stderr)
+            return 2
+        if args.shard and args.remat_every > 0 and spec.uses_node_sets:
+            print("--scenario with node-scoped faults cannot compose with "
+                  "--shard --remat-every: the epoch re-partition permutes "
+                  "peers, so compiled node masks would hit the wrong rows "
+                  "after the first rebuild (scalar loss/delay/full-swarm "
+                  "churn phases are fine)", file=sys.stderr)
+            return 2
     if args.profile_round > 0 and args.shard:
         print("--profile-round decomposes the LOCAL round (use "
               "experiments/dist_profile.py for the mesh engines)",
@@ -142,7 +182,7 @@ def main(argv: list[str] | None = None) -> int:
     mplan = exists = None
     if args.graph == "matching":
         if args.shard:
-            return _main_shard_matching(args, rng)
+            return _main_shard_matching(args, rng, spec)
         if args.remat_every > 0:
             print("--graph matching cannot re-materialize locally (its "
                   "pairing IS the delivery plan — a folded CSR has no "
@@ -167,7 +207,7 @@ def main(argv: list[str] | None = None) -> int:
         graph = topology.build_csr(args.peers, edges)
 
     if args.shard:
-        return _main_shard(args, graph, rng)
+        return _main_shard(args, graph, rng, spec)
 
     cfg = SwarmConfig(
         n_peers=graph.n,
@@ -209,25 +249,76 @@ def main(argv: list[str] | None = None) -> int:
     if args.profile_round > 0:
         return _main_profile_round(args, cfg, state, plan)
 
+    scen = _compile_cli_scenario(spec, args, n_slots=graph.n)
     with trace(args.profile):
         if args.remat_every > 0:
-            summary, fin = _run_with_remat(args, cfg, state)
+            summary, fin = _run_with_remat(args, cfg, state, scen)
+            summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
-            fin, stats = simulate(state, cfg, args.rounds, plan, args.tail)
+            fin, stats = simulate(state, cfg, args.rounds, plan, args.tail,
+                                  scen)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            summary = _horizon_summary(args, stats)
+            summary = _horizon_summary(args, stats, **_scenario_summary(spec, stats))
         else:
-            result, fin = M.bench_swarm(
-                state, cfg, args.target, args.max_rounds, plan=plan,
-                tail=args.tail,
-            )
-            summary = {"summary": True, "mode": args.mode, **json.loads(result.to_json())}
+            if scen is None:
+                result, fin = M.bench_swarm(
+                    state, cfg, args.target, args.max_rounds, plan=plan,
+                    tail=args.tail,
+                )
+            else:
+                from tpu_gossip.sim.engine import run_until_coverage
+
+                result, fin = M.bench_swarm(
+                    state, cfg, args.target, args.max_rounds,
+                    run=lambda st: run_until_coverage(
+                        st, cfg, args.target, args.max_rounds, plan=plan,
+                        tail=args.tail, scenario=scen,
+                    ),
+                )
+            summary = {"summary": True, "mode": args.mode,
+                       **_scenario_summary(spec),
+                       **json.loads(result.to_json())}
     print(json.dumps(summary))
 
     if args.checkpoint:
         save_swarm(args.checkpoint, fin)
     return 0
+
+
+def _compile_cli_scenario(
+    spec, args, n_slots, node_map=None, shard_ranges=None, n_shards=None
+):
+    """Compile the parsed --scenario for one engine's slot layout (node
+    sets are declared over real peer ids; ``node_map`` carries the
+    engine's id→row mapping — the bucketed mesh's load-balance
+    permutation, the sharded matching row formula)."""
+    if spec is None:
+        return None
+    from tpu_gossip.faults import compile_scenario
+
+    return compile_scenario(
+        spec,
+        n_peers=args.peers,
+        n_slots=n_slots,
+        total_rounds=args.rounds if args.rounds > 0 else args.max_rounds,
+        node_map=node_map,
+        shard_ranges=shard_ranges,
+        n_shards=n_shards,
+    )
+
+
+def _scenario_summary(spec, stats=None) -> dict:
+    """Summary-row fields for an active scenario (+ per-phase report when
+    per-round stats exist)."""
+    if spec is None:
+        return {}
+    out = {"scenario": spec.name}
+    if stats is not None:
+        from tpu_gossip.sim import metrics as M
+
+        out["phases"] = M.phase_report(stats, spec)
+    return out
 
 
 def _main_profile_round(args, cfg, state, plan) -> int:
@@ -266,7 +357,7 @@ def _main_profile_round(args, cfg, state, plan) -> int:
     return 0
 
 
-def _run_with_remat(args, cfg, state):
+def _run_with_remat(args, cfg, state, scen=None):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
     The first re-materialization pads col_idx to the fixed capacity, so the
@@ -308,9 +399,10 @@ def _run_with_remat(args, cfg, state):
 
     def run_segment(st, seg, plan):
         if args.rounds > 0:
-            return simulate(st, cfg, seg, plan, args.tail)
+            return simulate(st, cfg, seg, plan, args.tail, scen)
         return run_until_coverage(
-            st, cfg, args.target, seg, plan=plan, tail=args.tail
+            st, cfg, args.target, seg, plan=plan, tail=args.tail,
+            scenario=scen,
         ), None
 
     # warm EVERY shape the timed loop will see, on throwaway clones:
@@ -400,7 +492,7 @@ def _horizon_summary(args, stats, **extra):
     }
 
 
-def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
+def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
     """The mesh epoch loop (SURVEY.md §7.4's full churn lifecycle):
 
         R churned rounds -> fold fresh edges into the CSR
@@ -438,11 +530,12 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
 
     seg0 = min(r, total)
     if args.rounds > 0:
-        warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans)[0]
+        warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans,
+                             scen)[0]
     else:
         warm = run_until_coverage_dist(
             clone_state(state), cfg, sg, mesh, args.target, seg0,
-            shard_plan=plans,
+            shard_plan=plans, scenario=scen,
         )
     float(warm.coverage(0))
     del warm
@@ -451,11 +544,13 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
     while int(state.round) < total:
         seg = min(r, total - int(state.round))
         if args.rounds > 0:
-            state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans)
+            state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans,
+                                         scen)
             stats_parts.append(stats)
         else:
             state = run_until_coverage_dist(
-                state, cfg, sg, mesh, args.target, seg, shard_plan=plans
+                state, cfg, sg, mesh, args.target, seg, shard_plan=plans,
+                scenario=scen,
             )
             if float(state.coverage(0)) >= args.target:
                 break
@@ -502,7 +597,7 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans):
     return summary, state
 
 
-def _main_shard_matching(args, rng) -> int:
+def _main_shard_matching(args, rng, spec=None) -> int:
     """--shard --graph matching: the gather-free pipeline on the mesh.
 
     The swarm is laid out per shard at build time
@@ -538,7 +633,7 @@ def _main_shard_matching(args, rng) -> int:
             args.peers, gamma=args.gamma, fanout=None,
             key=jax.random.key(args.seed),
         )
-        return _main_shard(args, dgraph.to_host_graph(), rng)
+        return _main_shard(args, dgraph.to_host_graph(), rng, spec)
 
     if args.remat_every > 0:
         return fallback_to_csr_shard(
@@ -594,21 +689,31 @@ def _main_shard_matching(args, rng) -> int:
         state.silent = state.silent.at[to_rows(silent_ids)].set(True)
     state = shard_swarm(state, mesh)
 
+    scen = _compile_cli_scenario(
+        spec, args, n_slots=plan.n, node_map=to_rows,
+        shard_ranges=[(s * plan.n_blk, (s + 1) * plan.n_blk)
+                      for s in range(mesh.size)],
+        n_shards=mesh.size,
+    )
     with trace(args.profile):
         if args.rounds > 0:
-            fin, stats = simulate_dist(state, cfg, plan, mesh, args.rounds)
+            fin, stats = simulate_dist(state, cfg, plan, mesh, args.rounds,
+                                       None, scen)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            summary = _horizon_summary(args, stats, devices=mesh.size)
+            summary = _horizon_summary(args, stats, devices=mesh.size,
+                                       **_scenario_summary(spec, stats))
         else:
             result, fin = M.bench_swarm(
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
                 run=lambda st: run_until_coverage_dist(
-                    st, cfg, plan, mesh, args.target, args.max_rounds
+                    st, cfg, plan, mesh, args.target, args.max_rounds,
+                    scenario=scen,
                 ),
             )
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
+                       **_scenario_summary(spec),
                        **json.loads(result.to_json())}
     print(json.dumps(summary))
 
@@ -617,7 +722,7 @@ def _main_shard_matching(args, rng) -> int:
     return 0
 
 
-def _main_shard(args, graph, rng) -> int:
+def _main_shard(args, graph, rng, spec=None) -> int:
     """The --shard path: identical protocol, peers 1-D sharded over every
     available device with bucketed all_to_all fan-out (dist/mesh.py)."""
     import jax
@@ -658,16 +763,26 @@ def _main_shard(args, graph, rng) -> int:
         state.silent = state.silent.at[position[silent_ids]].set(True)
     state = shard_swarm(state, mesh)
 
+    scen = _compile_cli_scenario(
+        spec, args, n_slots=sg.n_pad,
+        node_map=lambda ids: position[np.asarray(ids)],
+        shard_ranges=[(s * sg.per_shard, (s + 1) * sg.per_shard)
+                      for s in range(mesh.size)],
+        n_shards=mesh.size,
+    )
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
-                args, cfg, state, sg, mesh, plans
+                args, cfg, state, sg, mesh, plans, scen
             )
+            summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
-            fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds, plans)
+            fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
+                                       plans, scen)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            summary = _horizon_summary(args, stats, devices=mesh.size)
+            summary = _horizon_summary(args, stats, devices=mesh.size,
+                                       **_scenario_summary(spec, stats))
         else:
             # the shared timing harness (warmup, fetch barrier) with the
             # dist engine's while_loop swapped in; report the real peer
@@ -676,10 +791,11 @@ def _main_shard(args, graph, rng) -> int:
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
                 run=lambda st: run_until_coverage_dist(
                     st, cfg, sg, mesh, args.target, args.max_rounds,
-                    shard_plan=plans,
+                    shard_plan=plans, scenario=scen,
                 ),
             )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
+                       **_scenario_summary(spec),
                        **json.loads(result.to_json())}
     print(json.dumps(summary))
 

@@ -120,6 +120,18 @@ class SwarmState:
     # (reference demonstrate_powerlaw.py:5-39 applied at rejoin time)
     rewired: jax.Array  # bool (N,) — slot re-attached since graph build
     rewire_targets: jax.Array  # int32 (N, S>=1) — fresh neighbors of rewired slots
+    # chaos scenarios (faults/): deliveries a delay fault is holding for a
+    # later round. Together with ``round`` this is the checkpointable
+    # scenario CURSOR — resume a mid-scenario checkpoint with the same
+    # compiled scenario and the schedule replays bit-exactly (phases are
+    # absolute-round-indexed). All-False unless a loss/delay scenario has
+    # run; checkpoints that predate the field load with it zeroed (faults
+    # off). The no-scenario round path carries the buffer UNTOUCHED (a
+    # per-round merge would tax every normal round for an almost-always
+    # empty buffer) — resuming a mid-delay checkpoint without its
+    # scenario freezes the backlog; release it explicitly with
+    # ``tpu_gossip.faults.drain_held(state)``.
+    fault_held: jax.Array  # bool (N, M)
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -160,15 +172,22 @@ def save_swarm(path, state: SwarmState) -> None:
 def load_swarm(path) -> SwarmState:
     """Restore a :func:`save_swarm` checkpoint (named-field format, with a
     fallback for round-1 positional checkpoints: those predate ``exists``,
-    which defaults to all-True — correct for their unpadded swarms)."""
+    which defaults to all-True — correct for their unpadded swarms).
+    Named-format checkpoints that predate the scenario engine lack
+    ``fault_held``; they load with it zeroed — faults disabled, exactly
+    their semantics when saved."""
     data = np.load(path)
     kwargs = {}
     if any(k.startswith("field_") or k.startswith("prngkey_") for k in data.files):
         for f in dataclasses.fields(SwarmState):
             if f"prngkey_{f.name}" in data:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
+            elif f.name == "fault_held" and f"field_{f.name}" not in data:
+                continue  # pre-scenario checkpoint: zero-filled below
             else:
                 kwargs[f.name] = jnp.asarray(data[f"field_{f.name}"])
+        if "fault_held" not in kwargs:
+            kwargs["fault_held"] = jnp.zeros(kwargs["seen"].shape, dtype=bool)
     else:  # legacy positional layout
         for i, name in enumerate(_V1_FIELDS):
             if f"key_{i}" in data:
@@ -190,6 +209,7 @@ def load_swarm(path) -> SwarmState:
             kwargs["recovered"] = kwargs["seen"] & kwargs["recovered"][:, None]
         kwargs["rewired"] = jnp.zeros((n,), dtype=bool)
         kwargs["rewire_targets"] = jnp.zeros((n, 1), dtype=jnp.int32)
+        kwargs["fault_held"] = jnp.zeros((n, m), dtype=bool)
     return SwarmState(**kwargs)
 
 
@@ -339,6 +359,7 @@ def init_swarm(
         declared_dead=jnp.zeros((n,), dtype=bool),
         rewired=jnp.zeros((n,), dtype=bool),
         rewire_targets=jnp.zeros((n, s), dtype=jnp.int32),
+        fault_held=jnp.zeros((n, m), dtype=bool),
         rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

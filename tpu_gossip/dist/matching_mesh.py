@@ -323,58 +323,36 @@ def _matching_flood_dist(
     return ex(*operands)
 
 
-def gossip_round_dist_matching(
+def _disseminate_matching_dist(
     state: SwarmState,
     cfg: SwarmConfig,
     plan: MatchingPlan,
     mesh: Mesh,
-) -> tuple[SwarmState, "jax.Array"]:
-    """One multi-chip matching round: sharded pipeline + shared protocol
-    tail.
+    transmit: jax.Array,
+    transmitter: jax.Array,
+    receptive: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """The sharded matching dissemination core; returns (incoming, msgs).
 
-    Key splits mirror ``sim.engine.gossip_round`` + ``_disseminate_local``
-    exactly, and the exchange draws the same RNG stream — the round is
-    bit-identical to the local engine on the same plan and state. Churn
-    re-wiring masks the static pipeline like the local kernel path and
-    routes fresh-edge traffic through
-    ``sim.engine.fresh_rewire_traffic`` outside ``shard_map``.
+    Key splits mirror ``sim.engine._disseminate_local`` split for split
+    and the exchange draws the same RNG stream — bit-identical to the
+    local engine on the same plan, state, masks, and keys. Factored out
+    of the round so the chaos engine (faults/inject.py) can wrap it with
+    blackout masks and two-pass partition delivery, identically on both
+    engines.
     """
     from tpu_gossip.sim.engine import (
-        advance_round,
-        compute_roles,
         fresh_rewire_traffic,
         kernel_path_masks,
-        transmit_bitmap,
-        validate_rewire_width,
     )
-
-    if plan.mesh_shards != mesh.size:
-        raise ValueError(
-            f"plan laid out for {plan.mesh_shards} shards but mesh has "
-            f"{mesh.size} devices — rebuild with "
-            f"matching_powerlaw_graph_sharded(n, {mesh.size})"
-        )
-    validate_rewire_width(state, cfg)
-    rnd = state.round + 1
-    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
-    k_push, k_rw_push = jax.random.split(k_push)
-    k_pull, k_rw_pull = jax.random.split(k_pull)
-    _, transmitter, receptive = compute_roles(state)
-    transmit = transmit_bitmap(state, cfg, transmitter)
 
     incoming = jnp.zeros_like(state.seen)
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     if cfg.mode in ("push", "push_pull"):
-        if plan.fanout is None or plan.deg_other is None:
-            raise ValueError(
-                "sampled matching delivery needs a plan built with fanout= "
-                "(matching_powerlaw_graph_sharded(..., fanout=cfg.fanout))"
-            )
-        if plan.fanout != cfg.fanout:
-            raise ValueError(
-                f"plan built for fanout={plan.fanout} but cfg.fanout="
-                f"{cfg.fanout}"
-            )
+        k_push, k_rw_push = jax.random.split(k_push)
+        k_pull, k_rw_pull = jax.random.split(k_pull)
         tx, answer, rec_rows = kernel_path_masks(
             state, cfg, transmit, transmitter, receptive
         )
@@ -401,8 +379,80 @@ def gossip_round_dist_matching(
         msgs_sent = msgs_sent + jnp.sum(
             transmit.sum(-1, dtype=jnp.int32) * deg
         )
+    return incoming, msgs_sent
 
+
+def gossip_round_dist_matching(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    plan: MatchingPlan,
+    mesh: Mesh,
+    scenario=None,
+) -> tuple[SwarmState, "jax.Array"]:
+    """One multi-chip matching round: sharded pipeline + shared protocol
+    tail.
+
+    Key splits mirror ``sim.engine.gossip_round`` + ``_disseminate_local``
+    exactly, and the exchange draws the same RNG stream — the round is
+    bit-identical to the local engine on the same plan and state,
+    ``scenario`` (faults/) included: the fault stream derives identically
+    and every fault draw is made at global shape outside ``shard_map``.
+    Churn re-wiring masks the static pipeline like the local kernel path
+    and routes fresh-edge traffic through
+    ``sim.engine.fresh_rewire_traffic`` outside ``shard_map``.
+    """
+    from tpu_gossip.sim.engine import (
+        advance_round,
+        compute_roles,
+        transmit_bitmap,
+        validate_rewire_width,
+    )
+
+    if plan.mesh_shards != mesh.size:
+        raise ValueError(
+            f"plan laid out for {plan.mesh_shards} shards but mesh has "
+            f"{mesh.size} devices — rebuild with "
+            f"matching_powerlaw_graph_sharded(n, {mesh.size})"
+        )
+    if cfg.mode in ("push", "push_pull"):
+        if plan.fanout is None or plan.deg_other is None:
+            raise ValueError(
+                "sampled matching delivery needs a plan built with fanout= "
+                "(matching_powerlaw_graph_sharded(..., fanout=cfg.fanout))"
+            )
+        if plan.fanout != cfg.fanout:
+            raise ValueError(
+                f"plan built for fanout={plan.fanout} but cfg.fanout="
+                f"{cfg.fanout}"
+            )
+    validate_rewire_width(state, cfg)
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    _, transmitter, receptive = compute_roles(state)
+    transmit = transmit_bitmap(state, cfg, transmitter)
+
+    if scenario is None:
+        incoming, msgs_sent = _disseminate_matching_dist(
+            state, cfg, plan, mesh, transmit, transmitter, receptive,
+            k_push, k_pull,
+        )
+        return advance_round(
+            state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
+            k_join, receptive,
+        )
+    from tpu_gossip.faults.inject import scenario_dissemination
+
+    def deliver(tx, tr, rc, k_dpush, k_dpull):
+        return _disseminate_matching_dist(
+            state, cfg, plan, mesh, tx, tr, rc, k_dpush, k_dpull
+        )
+
+    incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
+        scenario, state, rnd, transmit, transmitter, receptive,
+        k_push, k_pull, deliver,
+    )
     return advance_round(
-        state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join,
-        receptive,
+        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
+        receptive, faults=rf, churn_faults=scenario.has_churn,
+        fault_held=held, fstats=telem,
     )

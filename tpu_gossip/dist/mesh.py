@@ -640,21 +640,24 @@ def _exchange(
     )
 
 
-def gossip_round_dist(
+def _disseminate_bucketed(
     state: SwarmState,
     cfg: SwarmConfig,
-    sg: "ShardedGraph | object",
+    sg: ShardedGraph,
     mesh: Mesh,
-    shard_plan: ShardPlans | None = None,
-) -> tuple[SwarmState, RoundStats]:
-    """One multi-chip round: bucketed exchange + the shared protocol tail.
+    shard_plan: ShardPlans | None,
+    transmit: jax.Array,
+    transmitter: jax.Array,
+    receptive: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """The bucketed engine's dissemination core; returns (incoming, msgs).
 
-    ``sg`` selects the delivery engine: a :class:`ShardedGraph` runs the
-    bucketed CSR exchange below (any imported/repartitioned topology); a
-    :class:`~tpu_gossip.core.matching_topology.MatchingPlan` (built by
-    ``matching_powerlaw_graph_sharded``) runs the gather-free matching
-    pipeline with its transposes as dense ``all_to_all`` collectives
-    (dist/matching_mesh.py) — bit-identical to the local matching round.
+    Factored out of :func:`gossip_round_dist` so the chaos engine
+    (faults/inject.py) can wrap it — blackout masks, two-pass partition
+    delivery — exactly as it wraps the local and matching cores: the
+    fault structure exists once, the delivery engines stay oblivious.
 
     With churn re-wiring (``cfg.rewire_slots > 0``, push/push_pull), the
     static bucket traffic is masked the way the local engine masks stale
@@ -663,31 +666,10 @@ def gossip_round_dist(
     degree-preferential edges carry their traffic via
     :func:`~tpu_gossip.sim.engine.fresh_rewire_traffic` (outside shard_map —
     XLA's SPMD partitioner inserts the collectives). Flood mode ignores
-    re-wiring (both
-    engines: the flood is defined over the static CSR)."""
-    from tpu_gossip.core.matching_topology import MatchingPlan
-
-    if isinstance(sg, MatchingPlan):
-        if shard_plan is not None:
-            raise ValueError(
-                "shard_plan is the bucketed CSR engine's staircase receive; "
-                "matching delivery has no scatter to replace — pass "
-                "shard_plan=None"
-            )
-        return gossip_round_dist_matching(state, cfg, sg, mesh)
-    if sg.n_shards != mesh.size:
-        raise ValueError(
-            f"graph partitioned for {sg.n_shards} shards but mesh has "
-            f"{mesh.size} devices — repartition with partition_graph(g, {mesh.size})"
-        )
-    validate_rewire_width(state, cfg)
-    rnd = state.round + 1
-    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    re-wiring (both engines: the flood is defined over the static CSR).
+    """
     k_push, k_rw_push = jax.random.split(k_push)
     k_pull, k_rw_pull = jax.random.split(k_pull)
-    _, transmitter, receptive = compute_roles(state)
-    transmit = transmit_bitmap(state, cfg, transmitter)
-
     rewiring = cfg.rewire_slots > 0 and cfg.mode in ("push", "push_pull")
     # a rewired sender's static CSR out-edges are the departed occupant's:
     # they carry nothing (its traffic rides its fresh edges below); its
@@ -752,9 +734,78 @@ def gossip_round_dist(
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + msgs
+    return incoming, msgs_sent
 
+
+def gossip_round_dist(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    sg: "ShardedGraph | object",
+    mesh: Mesh,
+    shard_plan: ShardPlans | None = None,
+    scenario=None,
+) -> tuple[SwarmState, RoundStats]:
+    """One multi-chip round: bucketed exchange + the shared protocol tail.
+
+    ``sg`` selects the delivery engine: a :class:`ShardedGraph` runs the
+    bucketed CSR exchange (:func:`_disseminate_bucketed` — any imported/
+    repartitioned topology); a
+    :class:`~tpu_gossip.core.matching_topology.MatchingPlan` (built by
+    ``matching_powerlaw_graph_sharded``) runs the gather-free matching
+    pipeline with its transposes as dense ``all_to_all`` collectives
+    (dist/matching_mesh.py) — bit-identical to the local matching round.
+
+    ``scenario`` (faults/) applies the identical fault structure the
+    local engine applies — fault draws at GLOBAL shape outside
+    ``shard_map``, the same derived fault stream — so a scenario round
+    stays bit-identical between a matching mesh run and its local twin,
+    and distribution-equal for the bucketed engine (its baseline
+    contract)."""
+    from tpu_gossip.core.matching_topology import MatchingPlan
+
+    if isinstance(sg, MatchingPlan):
+        if shard_plan is not None:
+            raise ValueError(
+                "shard_plan is the bucketed CSR engine's staircase receive; "
+                "matching delivery has no scatter to replace — pass "
+                "shard_plan=None"
+            )
+        return gossip_round_dist_matching(state, cfg, sg, mesh,
+                                          scenario=scenario)
+    if sg.n_shards != mesh.size:
+        raise ValueError(
+            f"graph partitioned for {sg.n_shards} shards but mesh has "
+            f"{mesh.size} devices — repartition with partition_graph(g, {mesh.size})"
+        )
+    validate_rewire_width(state, cfg)
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    _, transmitter, receptive = compute_roles(state)
+    transmit = transmit_bitmap(state, cfg, transmitter)
+    if scenario is None:
+        incoming, msgs_sent = _disseminate_bucketed(
+            state, cfg, sg, mesh, shard_plan, transmit, transmitter,
+            receptive, k_push, k_pull,
+        )
+        return advance_round(
+            state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
+            k_join, receptive,
+        )
+    from tpu_gossip.faults.inject import scenario_dissemination
+
+    def deliver(tx, tr, rc, k_dpush, k_dpull):
+        return _disseminate_bucketed(
+            state, cfg, sg, mesh, shard_plan, tx, tr, rc, k_dpush, k_dpull
+        )
+
+    incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
+        scenario, state, rnd, transmit, transmitter, receptive,
+        k_push, k_pull, deliver,
+    )
     return advance_round(
-        state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join, receptive
+        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
+        receptive, faults=rf, churn_faults=scenario.has_churn,
+        fault_held=held, fstats=telem,
     )
 
 
@@ -770,16 +821,20 @@ def simulate_dist(
     mesh: Mesh,
     num_rounds: int,
     shard_plan: ShardPlans | None = None,
+    scenario=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
     DONATES ``state`` like the local engine (sim/engine.py simulate): the
     sharded per-peer buffers alias the output instead of being copied
     every call — pass ``clone_state(state)`` to keep the input alive.
+    ``scenario`` threads a compiled fault schedule (faults/) through the
+    scan, exactly as in the local engine.
     """
 
     def body(carry, _):
-        nxt, stats = gossip_round_dist(carry, cfg, sg, mesh, shard_plan)
+        nxt, stats = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
+                                       scenario)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -799,18 +854,21 @@ def run_until_coverage_dist(
     max_rounds: int = 1000,
     slot: int = 0,
     shard_plan: ShardPlans | None = None,
+    scenario=None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
     DONATES ``state`` (see :func:`simulate_dist`); pass
-    ``clone_state(state)`` to keep the input alive.
+    ``clone_state(state)`` to keep the input alive. ``scenario`` injects
+    a compiled fault schedule (faults/); rounds past its horizon run
+    quiescent.
     """
 
     def cond(st: SwarmState) -> jax.Array:
         return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)
 
     def body(st: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan)
+        nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan, scenario)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

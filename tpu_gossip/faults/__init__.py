@@ -1,0 +1,44 @@
+"""Chaos scenario engine: deterministic, time-phased fault injection.
+
+The robustness pillar next to the perf and correctness-tooling work: a
+declarative schedule (TOML/dict) of message loss, delivery delay,
+partitions, blackouts, and churn bursts compiles to jit-friendly device
+tables (:mod:`~tpu_gossip.faults.scenario`) that every engine — local,
+bucketed mesh, matching mesh — applies identically from a dedicated PRNG
+stream (:mod:`~tpu_gossip.faults.inject`), extending the local↔sharded
+bit-identity contract to every scenario. See docs/fault_model.md.
+"""
+
+from tpu_gossip.faults.inject import (
+    CompiledScenario,
+    FaultTelemetry,
+    RoundFaults,
+    drain_held,
+    faulted_dissemination,
+    scenario_dissemination,
+)
+from tpu_gossip.faults.scenario import (
+    FaultPhase,
+    NodeSet,
+    ScenarioError,
+    ScenarioSpec,
+    compile_scenario,
+    parse_scenario,
+    scenario_from_dict,
+)
+
+__all__ = [
+    "CompiledScenario",
+    "FaultTelemetry",
+    "RoundFaults",
+    "drain_held",
+    "faulted_dissemination",
+    "scenario_dissemination",
+    "FaultPhase",
+    "NodeSet",
+    "ScenarioError",
+    "ScenarioSpec",
+    "compile_scenario",
+    "parse_scenario",
+    "scenario_from_dict",
+]

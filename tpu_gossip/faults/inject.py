@@ -1,0 +1,318 @@
+"""Runtime fault injection: the chaos engine's on-device half.
+
+A compiled scenario (:mod:`tpu_gossip.faults.scenario`) is a pytree of
+per-phase parameter tables plus a per-round phase index — jit-static in
+STRUCTURE (which fault classes exist is decided at trace time via the
+``has_*`` metadata) and traced in VALUE (phase boundaries, probabilities,
+node masks), so one compile serves the whole time-phased schedule and the
+round loop stays a single ``lax.scan``/``while_loop`` with the round
+counter in the state acting as the scenario cursor.
+
+Every fault draw comes from a dedicated per-round stream derived by
+``fold_in(state.rng, FAULT_STREAM_SALT)`` — the round's 5-way protocol
+split is untouched, so a quiescent scenario (or phases with zero
+probabilities) leaves the no-scenario trajectory BIT-IDENTICAL, and all
+draws are made at GLOBAL shape outside ``shard_map`` (threefry bits are
+position-deterministic), which extends the local ↔ sharded bit-identity
+contract (tests/sim/test_dist.py) to every scenario for free.
+
+Fault classes and their semantics (docs/fault_model.md has the catalogue
+and the modeling caveats):
+
+- **loss** — each delivered (receiver, slot) bit is dropped with
+  probability ``loss`` this round. Applied at the delivery interface (the
+  merged incoming bitmap), i.e. last-hop receiver-side loss: exact
+  per-edge loss for single-copy deliveries (the overwhelmingly common
+  case under sampled push), a lower bound on multi-copy rounds.
+- **delay** — surviving deliveries are deferred with probability
+  ``delay`` into the state's ``fault_held`` buffer and re-offered next
+  round, where they may defer again: geometric holding, mean extra
+  latency ``delay/(1-delay)`` rounds. Held bits a receiver has meanwhile
+  seen are dropped from the buffer (they would merge to nothing).
+- **partition** — the swarm splits into two groups (``group_b`` mask);
+  delivery runs once per group over group-masked transmit/transmitter/
+  receptive and cross-group bits are discarded. Sends into the boundary
+  are still billed (they were transmitted; the network ate them).
+- **blackout** — nodes in the mask neither send, receive, nor heartbeat
+  for the phase (the transient-outage sibling of churn: protocol state
+  survives). The failure detector sees them exactly like silent-mode
+  peers (reference Peer.py:437-439), so a blackout longer than the
+  timeout produces dead declarations — which are PERMANENT, as in the
+  reference's registry purge.
+- **churn burst** — extra per-round leave/join probability over a node
+  mask, folded into the engine's existing churn draws (same keys, same
+  shapes — per-node thresholds change, the stream does not).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "FAULT_STREAM_SALT",
+    "CompiledScenario",
+    "RoundFaults",
+    "FaultTelemetry",
+    "faulted_dissemination",
+    "scenario_dissemination",
+    "drain_held",
+]
+
+# folds the round's root key (state.rng) into the fault stream — a
+# derivation parallel to the protocol's 5-way split, never overlapping it
+FAULT_STREAM_SALT = 0x5CE7A510
+
+
+class RoundFaults(NamedTuple):
+    """One round's fault parameters (traced scalars + (N,) node masks)."""
+
+    loss: jax.Array  # f32 — P(drop a delivered (receiver, slot) bit)
+    delay: jax.Array  # f32 — P(defer a surviving delivery one round)
+    leave: jax.Array  # f32 — extra per-round leave probability (burst rows)
+    join: jax.Array  # f32 — extra per-round rejoin probability (burst rows)
+    burst: jax.Array  # bool (N,) — rows the churn burst applies to
+    blackout: jax.Array  # bool (N,) — rows cut off from the network
+    group_b: jax.Array  # bool (N,) — partition side B (False = side A)
+
+
+class FaultTelemetry(NamedTuple):
+    """Per-round fault counters for RoundStats (all scalar int32)."""
+
+    msgs_dropped: jax.Array  # deliveries eaten by the loss fault
+    msgs_held: jax.Array  # deliveries sitting in the delay buffer
+    msgs_delivered: jax.Array  # deliveries that landed this round
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CompiledScenario:
+    """A fault schedule compiled to device tables (faults/scenario.py).
+
+    ``phase_of_round[o]`` maps the 0-based round offset to a row of the
+    per-phase tables; row ``P`` (the last) is the quiescent no-fault row,
+    which also covers every round past the schedule (a healed network).
+    The ``has_*`` flags are STATIC: they decide trace structure (e.g. the
+    two-pass partition delivery exists only when some phase partitions),
+    so a scenario without a fault class costs nothing for it.
+    """
+
+    phase_of_round: jax.Array  # int32 (R+1,)
+    loss: jax.Array  # f32 (P+1,)
+    delay: jax.Array  # f32 (P+1,)
+    leave: jax.Array  # f32 (P+1,)
+    join: jax.Array  # f32 (P+1,)
+    burst: jax.Array  # bool (P+1, N)
+    blackout: jax.Array  # bool (P+1, N)
+    group_b: jax.Array  # bool (P+1, N)
+    name: str = dataclasses.field(default="scenario", metadata=dict(static=True))
+    has_partition: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_blackout: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_churn: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_loss_delay: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    n_rounds: int = dataclasses.field(default=0, metadata=dict(static=True))
+
+    def at_round(self, rnd: jax.Array) -> RoundFaults:
+        """The fault parameters governing round ``rnd`` (1-based, traced).
+
+        Rounds past the schedule clamp onto the quiescent row, so a
+        run-to-coverage loop that outlives the scenario finishes on a
+        healed network and any held deliveries drain (``delay`` is 0
+        there).
+        """
+        o = jnp.clip(rnd - 1, 0, self.phase_of_round.shape[0] - 1)
+        ph = self.phase_of_round[o]
+        return RoundFaults(
+            loss=self.loss[ph],
+            delay=self.delay[ph],
+            leave=self.leave[ph],
+            join=self.join[ph],
+            burst=self.burst[ph],
+            blackout=self.blackout[ph],
+            group_b=self.group_b[ph],
+        )
+
+
+def faulted_dissemination(
+    scenario: CompiledScenario,
+    rf: RoundFaults,
+    deliver: Callable,
+    transmit: jax.Array,
+    transmitter: jax.Array,
+    receptive: jax.Array,
+    held: jax.Array,
+    seen: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+    k_fault: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, FaultTelemetry]:
+    """Run one round's dissemination with the scenario's faults applied.
+
+    ``deliver(tx, transmitter, receptive, k_push, k_pull) -> (incoming,
+    msgs)`` is the engine's dissemination core (local XLA/kernel, bucketed
+    mesh, matching mesh) — the fault structure wraps it identically on
+    every engine, which is what makes a scenario round bit-identical
+    between the local and sharded runs of the same engine family.
+
+    Returns ``(incoming, msgs_sent, tx_effective, new_held, telemetry)``:
+    ``tx_effective`` is the transmit bitmap that actually left senders
+    this round (blackout senders pushed nothing — forward-once
+    bookkeeping must not mark them), ``new_held`` the delay buffer to
+    carry in the state.
+
+    Loss/delay draws are made EVERY round of a scenario that contains any
+    loss/delay phase, at full (N, M) shape regardless of the active phase
+    (quiescent thresholds make them no-ops): each draw's stream position
+    depends only on the round number, so phase edits never shift later
+    rounds' randomness and checkpoint resume mid-scenario replays
+    identically. A scenario WITHOUT loss/delay phases skips the stage
+    entirely (``has_loss_delay`` is static) — the keys are derived
+    independently, so skipping moves no other draw — keeping the
+    "absent fault classes cost nothing" contract.
+    """
+    k_loss, k_delay, k_push_b, k_pull_b = jax.random.split(k_fault, 4)
+
+    if scenario.has_partition:
+        ga = ~rf.group_b
+        gb = rf.group_b
+        if scenario.has_blackout:
+            ga = ga & ~rf.blackout
+            gb = gb & ~rf.blackout
+        ca, cb = ga[:, None], gb[:, None]
+        # one delivery pass per side, each over side-masked participants;
+        # a pass's cross-boundary bits are discarded receiver-side (they
+        # were billed — the network dropped them at the boundary)
+        inc_a, msgs_a = deliver(
+            transmit & ca, transmitter & ca, receptive & ca, k_push, k_pull
+        )
+        # the B pass only runs while a partition phase is ACTIVE: on
+        # quiescent rounds group B is empty and the pass would contribute
+        # exactly (zeros, 0), so lax.cond skips its full delivery cost at
+        # runtime. The predicate comes from replicated scenario tables —
+        # every shard takes the same branch, the same replicated-control
+        # regime as the collectives inside run_until_coverage_dist's
+        # while_loop — and the B keys are derived positionally either
+        # way, so no other draw's stream position moves.
+        inc_b, msgs_b = jax.lax.cond(
+            gb.any(),
+            lambda: deliver(
+                transmit & cb, transmitter & cb, receptive & cb,
+                k_push_b, k_pull_b,
+            ),
+            lambda: (
+                jnp.zeros_like(transmit),
+                jnp.zeros((), dtype=jnp.int32),
+            ),
+        )
+        raw = (inc_a & ca) | (inc_b & cb)
+        msgs = msgs_a + msgs_b
+        recv_ok = ga | gb
+    elif scenario.has_blackout:
+        lv = ~rf.blackout
+        lc = lv[:, None]
+        raw, msgs = deliver(
+            transmit & lc, transmitter & lc, receptive & lc, k_push, k_pull
+        )
+        raw = raw & lc
+        recv_ok = lv
+    else:
+        raw, msgs = deliver(transmit, transmitter, receptive, k_push, k_pull)
+        recv_ok = None
+
+    if scenario.has_loss_delay:
+        # loss: last-hop drop on the merged delivery bitmap
+        keep = jax.random.uniform(k_loss, raw.shape) >= rf.loss
+        dropped = jnp.sum(raw & ~keep, dtype=jnp.int32)
+        surviving = raw & keep
+
+        # delay: geometric holding in the state's fault_held buffer. Held
+        # bits release only to receivers that can currently receive (a
+        # blacked-out receiver's backlog waits out the phase); releases
+        # merge with fresh deliveries and may defer again. Bits the
+        # receiver has since seen are dropped from the buffer — they
+        # would merge to nothing.
+        release = held if recv_ok is None else held & recv_ok[:, None]
+        merged = surviving | release
+        defer = jax.random.uniform(k_delay, raw.shape) < rf.delay
+        incoming = merged & ~defer
+        new_held = merged & defer & ~seen
+        if recv_ok is not None:
+            new_held = new_held | (held & ~recv_ok[:, None])
+        telem = FaultTelemetry(
+            msgs_dropped=dropped,
+            msgs_held=jnp.sum(new_held, dtype=jnp.int32),
+            msgs_delivered=jnp.sum(incoming, dtype=jnp.int32),
+        )
+    else:
+        # no loss/delay phase anywhere in the schedule: the (N, M) draws
+        # and the hold-buffer merge would be pure per-round overhead —
+        # skip the stage (telemetry stays 0, like every absent fault)
+        incoming, new_held = raw, held
+        z = jnp.zeros((), dtype=jnp.int32)
+        telem = FaultTelemetry(msgs_dropped=z, msgs_held=z, msgs_delivered=z)
+
+    tx_eff = (
+        transmit & (~rf.blackout)[:, None] if scenario.has_blackout else transmit
+    )
+    return incoming, msgs, tx_eff, new_held, telem
+
+
+def scenario_dissemination(
+    scenario: CompiledScenario,
+    state,
+    rnd: jax.Array,
+    transmit: jax.Array,
+    transmitter: jax.Array,
+    receptive: jax.Array,
+    k_push: jax.Array,
+    k_pull: jax.Array,
+    deliver: Callable,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, FaultTelemetry, RoundFaults]:
+    """The whole per-round scenario head, shared by all three engines.
+
+    Looks up the round's fault parameters, derives the fault stream from
+    the round's root key (``fold_in(state.rng, FAULT_STREAM_SALT)`` — the
+    protocol's 5-way split is untouched), and runs
+    :func:`faulted_dissemination` around the engine's ``deliver`` core.
+    Returns ``(incoming, msgs_sent, tx_effective, new_held, telemetry,
+    round_faults)`` — the engine feeds the last three to
+    ``advance_round(..., faults=rf, churn_faults=scenario.has_churn,
+    fault_held=new_held, fstats=telemetry)``. Existing in ONE place so the
+    engines cannot drift: any change to the fault plumbing lands on every
+    engine at once, which is what keeps the bit-identity contract honest.
+    """
+    rf = scenario.at_round(rnd)
+    k_fault = jax.random.fold_in(state.rng, FAULT_STREAM_SALT)
+    incoming, msgs, tx_eff, new_held, telem = faulted_dissemination(
+        scenario, rf, deliver, transmit, transmitter, receptive,
+        state.fault_held, state.seen, k_push, k_pull, k_fault,
+    )
+    return incoming, msgs, tx_eff, new_held, telem, rf
+
+
+def drain_held(state):
+    """One-shot release of the delay buffer OUTSIDE any scenario.
+
+    Resuming a mid-delay checkpoint WITHOUT its scenario leaves
+    ``fault_held`` frozen — the no-scenario round path carries it
+    untouched on purpose (merging an almost-always-empty buffer every
+    round would tax the hot loop's HBM traffic for nothing). This helper
+    is the explicit drain for that case: held deliveries merge through
+    the same receptive gate a round would apply (alive, not declared
+    dead, not SIR-removed per slot), ``infected_round`` latches at the
+    current round, and the buffer clears. Pure; call once after load.
+    """
+    import dataclasses as _dc
+
+    active = state.alive & ~state.declared_dead
+    inc = state.fault_held & active[:, None] & ~state.recovered
+    latch = (inc & ~state.seen) & (state.infected_round < 0)
+    return _dc.replace(
+        state,
+        seen=state.seen | inc,
+        infected_round=jnp.where(latch, state.round, state.infected_round),
+        fault_held=jnp.zeros_like(state.fault_held),
+    )

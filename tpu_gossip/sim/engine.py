@@ -63,16 +63,28 @@ class RoundStats(NamedTuple):
     n_infected: jax.Array  # i32 — peers having seen slot 0 (incl. recovered)
     n_alive: jax.Array  # i32 — alive & not declared dead
     n_declared_dead: jax.Array  # i32 — failure-detector verdicts so far
+    # fault telemetry (faults/inject.py) — 0 unless a scenario with
+    # loss/delay phases is active (absent fault classes cost nothing,
+    # counters included)
+    msgs_dropped: jax.Array  # i32 — deliveries eaten by the loss fault
+    msgs_held: jax.Array  # i32 — deliveries sitting in the delay buffer
+    msgs_delivered: jax.Array  # i32 — deliveries landed through loss/delay
 
 
-def _stats(state: SwarmState, msgs_sent: jax.Array) -> RoundStats:
+def _stats(
+    state: SwarmState, msgs_sent: jax.Array, fstats=None
+) -> RoundStats:
     live = state.alive & ~state.declared_dead
+    z = jnp.zeros((), dtype=jnp.int32)
     return RoundStats(
         coverage=state.coverage(0),  # the one coverage definition (state.py)
         msgs_sent=msgs_sent.astype(jnp.int32),
         n_infected=jnp.sum(state.seen[:, 0] & live).astype(jnp.int32),
         n_alive=jnp.sum(live).astype(jnp.int32),
         n_declared_dead=jnp.sum(state.declared_dead).astype(jnp.int32),
+        msgs_dropped=z if fstats is None else fstats.msgs_dropped,
+        msgs_held=z if fstats is None else fstats.msgs_held,
+        msgs_delivered=z if fstats is None else fstats.msgs_delivered,
     )
 
 
@@ -641,6 +653,10 @@ def advance_round(
     receptive: jax.Array,
     *,
     tail: str = "fused",
+    faults=None,
+    churn_faults: bool = False,
+    fault_held: jax.Array | None = None,
+    fstats=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Everything after dissemination: dedup-merge, SIR, liveness, churn.
 
@@ -657,14 +673,31 @@ def advance_round(
     single-kernel launch) — all three are bit-identical (integer ops
     only), so any choice preserves the local↔sharded bit-identity
     contract.
+
+    ``faults`` (a :class:`~tpu_gossip.faults.inject.RoundFaults`) carries
+    an active scenario's per-round parameters: blacked-out nodes read as
+    silent to the liveness protocol (no heartbeats, no probe replies —
+    the transient-outage twin of the reference's operator-'1' fault), and
+    with ``churn_faults`` True the burst leave/join probabilities fold
+    into the existing churn draws as per-node thresholds — SAME keys,
+    SAME draw shapes, so engines stay bit-identical and a quiescent phase
+    changes nothing. ``fault_held`` is the delay buffer to carry
+    (defaults to the input's), ``fstats`` the round's fault telemetry.
     """
     # --- liveness (row-level) ---------------------------------------------
+    # a blacked-out node is cut off from the heartbeat plane too: it emits
+    # nothing anyone hears and answers no detector probe, exactly a silent
+    # peer for the phase's duration — dead declarations it earns persist
+    # (the reference's registry purge has no resurrection either)
+    silent_now = (
+        state.silent if faults is None else state.silent | faults.blackout
+    )
     last_hb = emit_heartbeats(
-        state.last_hb, state.alive, state.silent, state.declared_dead,
+        state.last_hb, state.alive, silent_now, state.declared_dead,
         rnd, cfg.hb_period_rounds,
     )
     last_hb, declared_dead = detect_failures(
-        last_hb, state.alive, state.silent, state.declared_dead,
+        last_hb, state.alive, silent_now, state.declared_dead,
         rnd, cfg.timeout_rounds, cfg.detect_period_rounds,
     )
 
@@ -678,18 +711,32 @@ def advance_round(
     rewired = state.rewired
     rewire_targets = state.rewire_targets
     fresh = None
-    if cfg.churn_leave_prob > 0.0:
-        leave = alive & (jax.random.uniform(k_leave, alive.shape) < cfg.churn_leave_prob)
+    burst = faults is not None and churn_faults
+    if cfg.churn_leave_prob > 0.0 or burst:
+        p_leave = cfg.churn_leave_prob
+        if burst:
+            # independent composition with the configured Poisson churn:
+            # P(leave) = 1-(1-p_cfg)(1-p_burst) on burst rows — the draw
+            # itself keeps its key and shape (bit-identity across engines)
+            p_leave = 1.0 - (1.0 - p_leave) * (
+                1.0 - jnp.where(faults.burst, faults.leave, 0.0)
+            )
+        leave = alive & (jax.random.uniform(k_leave, alive.shape) < p_leave)
         alive = alive & ~leave
-    if cfg.churn_join_prob > 0.0:
+    if cfg.churn_join_prob > 0.0 or burst:
         # vacant slots rejoin with fresh protocol state (jit-friendly churn,
         # SURVEY.md §7.4: fixed slots + alive masks instead of per-round CSR
         # rebuilds). Pad/sentinel slots (exists=False) never rejoin — they
         # are not peers, and resurrecting them would dilute the coverage
         # denominator with uninfectable degree-0 slots.
         k_join, k_rw = jax.random.split(k_join)
+        p_join = cfg.churn_join_prob
+        if burst:
+            p_join = 1.0 - (1.0 - p_join) * (
+                1.0 - jnp.where(faults.burst, faults.join, 0.0)
+            )
         join = (~alive) & state.exists & (
-            jax.random.uniform(k_join, alive.shape) < cfg.churn_join_prob
+            jax.random.uniform(k_join, alive.shape) < p_join
         )
         alive = alive | join
         fresh = join
@@ -782,14 +829,16 @@ def advance_round(
         declared_dead=declared_dead,
         rewired=rewired,
         rewire_targets=rewire_targets,
+        fault_held=state.fault_held if fault_held is None else fault_held,
         rng=key,
         round=rnd,
     )
-    return new_state, _stats(new_state, msgs_sent)
+    return new_state, _stats(new_state, msgs_sent, fstats)
 
 
 def gossip_round(
-    state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused"
+    state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
+    scenario=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -797,18 +846,41 @@ def gossip_round(
     ``kernels.round_tail``): "fused" (default), "reference" (the historical
     multi-pass oracle), "pallas" (one kernel launch) — bit-identical all
     three.
+
+    ``scenario`` (a :class:`~tpu_gossip.faults.CompiledScenario`) injects
+    that round's faults: the protocol's 5-way key split is untouched and
+    the fault stream derives separately (``fold_in(state.rng,
+    FAULT_STREAM_SALT)``), so ``scenario=None`` — and any quiescent phase
+    — reproduces the historical trajectory bit for bit.
     """
     validate_rewire_width(state, cfg)
     rnd = state.round + 1
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = compute_roles(state)
     transmit = transmit_bitmap(state, cfg, transmitter)
-    incoming, msgs_sent = _disseminate_local(
-        state, cfg, transmit, transmitter, receptive, k_push, k_pull, plan
+    if scenario is None:
+        incoming, msgs_sent = _disseminate_local(
+            state, cfg, transmit, transmitter, receptive, k_push, k_pull, plan
+        )
+        return advance_round(
+            state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
+            k_join, receptive, tail=tail,
+        )
+    from tpu_gossip.faults.inject import scenario_dissemination
+
+    def deliver(tx, tr, rc, k_dpush, k_dpull):
+        return _disseminate_local(
+            state, cfg, tx, tr, rc, k_dpush, k_dpull, plan
+        )
+
+    incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
+        scenario, state, rnd, transmit, transmitter, receptive,
+        k_push, k_pull, deliver,
     )
     return advance_round(
-        state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave, k_join,
-        receptive, tail=tail,
+        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
+        receptive, tail=tail, faults=rf, churn_faults=scenario.has_churn,
+        fault_held=held, fstats=telem,
     )
 
 
@@ -819,7 +891,7 @@ def gossip_round(
 )
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
-    tail: str = "fused",
+    tail: str = "fused", scenario=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -828,10 +900,15 @@ def simulate(
     instead of being copied, so the caller's reference is DELETED by the
     call. Thread the result (``state, stats = simulate(state, ...)``) or
     pass ``clone_state(state)`` (core.state) to keep the original.
+
+    ``scenario`` threads a compiled fault schedule (faults/) through the
+    scan: the tables are loop-invariant operands, the round counter in the
+    carry is the scenario cursor.
     """
 
     def body(carry, _):
-        nxt, stats = gossip_round(carry, cfg, plan, tail=tail)
+        nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
+                                  scenario=scenario)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -850,6 +927,7 @@ def run_until_coverage(
     slot: int = 0,
     plan=None,
     tail: str = "fused",
+    scenario=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -859,13 +937,16 @@ def run_until_coverage(
     DONATES ``state`` (see :func:`simulate`): pass ``clone_state(state)``
     to keep the input alive — the ~1M×16-slot pytree is aliased into the
     loop carry instead of copied.
+
+    ``scenario`` injects a compiled fault schedule (faults/); rounds past
+    its horizon run quiescent, so the loop can outlive the scenario.
     """
 
     def cond(s: SwarmState) -> jax.Array:
         return (s.coverage(slot) < target) & (s.round - state.round < max_rounds)
 
     def body(s: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round(s, cfg, plan, tail=tail)
+        nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

@@ -29,6 +29,8 @@ __all__ = [
     "bench_swarm",
     "write_jsonl",
     "stats_rows",
+    "recoverage_rounds",
+    "phase_report",
 ]
 
 
@@ -174,6 +176,83 @@ def run_with_metrics(
     if sink is not None:
         write_jsonl(stats, sink)
     return fin, stats
+
+
+def recoverage_rounds(
+    stats: RoundStats, after_round: int, target: float = 0.99
+) -> int:
+    """Rounds needed to regain ``target`` coverage after round
+    ``after_round`` (1-based — a partition's heal round, a churn storm's
+    end); -1 if the horizon never recovers. The scenario engine's
+    re-coverage metric: how fast the epidemic refills the side that
+    stalled behind a fault."""
+    cov = np.asarray(stats.coverage)[after_round:]
+    hit = np.nonzero(cov >= target)[0]
+    return int(hit[0]) + 1 if hit.size else -1
+
+
+def phase_report(
+    stats: RoundStats, spec, *, heal_target: float = 0.99
+) -> list[dict]:
+    """Per-phase fault telemetry from a fixed-horizon run under a scenario.
+
+    ``spec`` is the :class:`~tpu_gossip.faults.ScenarioSpec` the run was
+    compiled from (duck-typed: ``phases`` with name/start/end/partition).
+    Per phase: the delivery-loss rate (dropped / (dropped + delivered) —
+    the loss fault's realized bite), detection latency (rounds from phase
+    start to the first NEW dead declaration inside the phase — the
+    blackout/silence detection metric, SURVEY §2.5's 30–42 s band scaled
+    to rounds), and, for partition phases, the re-coverage time after
+    heal (:func:`recoverage_rounds`). Host-side, like every reporting
+    helper here — the device round loop carries only the three telemetry
+    counters in RoundStats.
+
+    ``n_declared_dead`` is NOT monotone (a churn rejoin clears a slot's
+    dead verdict), so detection counts the phase's PEAK over its starting
+    value — net revivals read as 0 new detections, never negative, and a
+    rejoin-then-fluctuation cannot fake a detection. ``heal_target`` is a
+    fraction of the RUN'S PEAK coverage, not absolute: graphs with an
+    unreachable tail (the matching builder's erased configuration model
+    strands ~1% at small sizes) still report a finite re-coverage time
+    once the epidemic regains 99% of what it can ever reach.
+    """
+    cov = np.asarray(stats.coverage)
+    dropped = np.asarray(stats.msgs_dropped)
+    held = np.asarray(stats.msgs_held)
+    delivered = np.asarray(stats.msgs_delivered)
+    dead = np.asarray(stats.n_declared_dead)
+    horizon = len(cov)
+    ceiling = float(cov.max()) if horizon else 0.0
+    rows: list[dict] = []
+    for p in spec.phases:
+        lo, hi = p.start, min(p.end, horizon)
+        if lo >= horizon:
+            continue
+        d = int(dropped[lo:hi].sum())
+        dv = int(delivered[lo:hi].sum())
+        dead_before = int(dead[lo - 1]) if lo > 0 else 0
+        newly_dead = np.nonzero(dead[lo:hi] > dead_before)[0]
+        detection_new = max(int(dead[lo:hi].max()) - dead_before, 0)
+        row = {
+            "phase": p.name,
+            "rounds": [lo + 1, hi],
+            "msgs_dropped": d,
+            "delivery_loss_rate": d / max(d + dv, 1),
+            "msgs_held_max": int(held[lo:hi].max()) if hi > lo else 0,
+            "detection_new": detection_new,
+            "detection_latency_rounds": (
+                int(newly_dead[0]) + 1
+                if detection_new > 0 and newly_dead.size
+                else -1
+            ),
+            "coverage_end": float(cov[hi - 1]),
+        }
+        if p.partition is not None:
+            row["recoverage_rounds_after_heal"] = recoverage_rounds(
+                stats, hi, heal_target * ceiling
+            )
+        rows.append(row)
+    return rows
 
 
 def expected_conflations(n_rumors: int, msg_slots: int) -> float:
