@@ -335,6 +335,90 @@ def bench_liveness(n: int = 1000, silent_frac: float = 0.1, rounds: int = 20,
     }
 
 
+def bench_grow(n_target: int, n0: int, joins_per_round: int = 256,
+               msg_slots: int = 16, reps: int = 1):
+    """Growth engine at headline scale (growth/, docs/growth_engine.md).
+
+    Admit ``n_target - n0`` peers by in-round preferential attachment
+    (Gumbel-top-k over the realized degree vector) and price the GROWING
+    round against the fixed-n round on the same capacity-padded state —
+    the admission stage's marginal cost is one (J, N) Gumbel + top-k +
+    registry scatters per round. Also reports the grown tail's γ-MLE so
+    the headline-scale degree-evolution claim is measured, not assumed.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.state import SwarmConfig, clone_state, init_swarm
+    from tpu_gossip.core.topology import fit_powerlaw_gamma
+    from tpu_gossip.growth import compile_growth, pad_graph_for_growth
+    from tpu_gossip.growth.engine import realized_degrees
+    from tpu_gossip.sim.engine import simulate
+
+    dg = device_powerlaw_graph(n0, gamma=2.5, key=jax.random.key(0))
+    cap = n_target + 1  # + the device builder's sentinel row
+    graph, pad_exists = pad_graph_for_growth(dg.as_padded_graph(), cap)
+    # the sentinel row must stay non-existent AND non-admittable: fold the
+    # builder's exists into the padded mask; admission starts past it
+    pad_exists[: n0 + 1] = np.asarray(dg.exists)
+    cfg = SwarmConfig(
+        n_peers=cap, msg_slots=msg_slots, fanout=1, mode="push_pull",
+        rewire_slots=3,
+    )
+    state = init_swarm(
+        graph, cfg, origins=np.arange(msg_slots),
+        origin_slots=np.arange(msg_slots),
+        exists=jnp.asarray(pad_exists), key=jax.random.key(0),
+    )
+    gp = compile_growth(
+        n_initial=n0 + 1, target=cap, n_slots=cap,
+        joins_per_round=joins_per_round, attach_m=3,
+    )
+    rounds = (n_target - n0) // joins_per_round + 2
+
+    def timed(grow):
+        best = float("inf")
+        fin = None
+        for _ in range(max(reps, 1)):
+            rep = clone_state(state)  # outside the timer (donation contract)
+            t0 = _time.perf_counter()
+            fin, _ = simulate(rep, cfg, rounds, None, "fused", None, grow)
+            float(fin.coverage(0))  # completion barrier
+            best = min(best, _time.perf_counter() - t0)
+        return best, fin
+
+    # warm both compiles on throwaway clones (simulate donates its state)
+    for g in (gp, None):
+        fin_w, _ = simulate(clone_state(state), cfg, rounds, None, "fused",
+                            None, g)
+        float(fin_w.coverage(0))
+    del fin_w
+    grow_wall, fin = timed(gp)
+    fixed_wall, _ = timed(None)
+    deg = np.asarray(realized_degrees(
+        fin.row_ptr, fin.exists, fin.rewired, fin.rewire_targets,
+        fin.degree_credit,
+    ))
+    gamma = fit_powerlaw_gamma(deg[np.asarray(fin.exists)])
+    ms_grow = grow_wall / rounds * 1000.0
+    ms_fixed = fixed_wall / rounds * 1000.0
+    return {
+        "n_initial": n0, "n_target": n_target,
+        "joins_per_round": joins_per_round, "rounds": rounds,
+        "n_members_final": int(np.asarray(fin.exists).sum()),
+        "growing": {"wall_seconds": round(grow_wall, 3),
+                    "ms_per_round": round(ms_grow, 4)},
+        "fixed_n": {"wall_seconds": round(fixed_wall, 3),
+                    "ms_per_round": round(ms_fixed, 4)},
+        "admission_overhead_vs_fixed": round(ms_grow / max(ms_fixed, 1e-9), 3),
+        "grown_degree_gamma": round(gamma, 4),
+    }
+
+
 def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
                       remat_every: int = 16, plan=None,
                       rewire_compact_cap: int = 0):
@@ -757,7 +841,7 @@ def main(argv: list[str] | None = None) -> int:
         """True (and records the skip) when the budget is too spent for
         ``section`` — the guard that keeps rc=0 with the headline printed."""
         frac = {"north_star_10m": 0.40, "dist_200k": 0.70,
-                "dist_1m": 0.78, "dist_10m": 0.88}[section]
+                "dist_1m": 0.78, "grow_1m": 0.84, "dist_10m": 0.88}[section]
         if elapsed() <= budget_s * frac:
             return False
         out["sections_skipped"].append(
@@ -1033,6 +1117,12 @@ def main(argv: list[str] | None = None) -> int:
                 "matching": bench_dist_matching(1_000_000, reps=reps),
             }
             flush_detail()
+        if not quick and not skip("grow_1m"):
+            # the growth engine at 1M capacity: admission-stage overhead
+            # (growing vs fixed-n round on the same state) + the grown
+            # tail's γ — the membership plane's headline numbers
+            out["grow_1m"] = bench_grow(1_000_000, 950_000, reps=reps)
+            flush_detail()
         if not quick and not skip("dist_10m"):
             # north-star scale on the mesh: matching only (partition_graph
             # buckets a 10M CSR host-side — minutes of numpy — while the
@@ -1108,6 +1198,14 @@ def _compact(out: dict) -> dict:
             else:  # recorded as unsupported on this mesh size
                 row["matching_unsupported"] = True
         compact[key] = row
+    g = out.get("grow_1m")
+    if g:
+        compact["grow_1m"] = {
+            "ms_per_round_growing": g["growing"]["ms_per_round"],
+            "ms_per_round_fixed": g["fixed_n"]["ms_per_round"],
+            "admission_overhead": g["admission_overhead_vs_fixed"],
+            "grown_degree_gamma": g["grown_degree_gamma"],
+        }
     if out.get("sections_skipped"):
         compact["sections_skipped"] = [
             s["section"] for s in out["sections_skipped"]

@@ -17,6 +17,7 @@ def test_audit_names_cover_declared_entry_points():
         "builder_csr",
         "builder_sharded",
         "gossip_round_local",
+        "growth_registry_plane",
         "simulate_and_coverage",
         "pallas_wrappers",
         "gossip_round_dist",
@@ -58,6 +59,30 @@ def test_broken_state_shape_detected(monkeypatch):
     monkeypatch.setattr(engine, "gossip_round", broken)
     findings = audit_contracts(names=["gossip_round_local"])
     assert findings and all("spec drift" in f.message for f in findings)
+
+
+def test_broken_growth_registry_detected(monkeypatch):
+    """Re-type a registry-plane leaf under an active growth schedule: the
+    growing round's fixed-point check must report it — the growth plane
+    is pinned the way fault_held is."""
+    from tpu_gossip.sim import engine
+
+    orig = engine.gossip_round
+
+    def broken(state, cfg, plan=None, **kw):
+        import dataclasses
+
+        st, stats = orig(state, cfg, plan, **kw)
+        if kw.get("growth") is not None:
+            st = dataclasses.replace(
+                st, degree_credit=st.degree_credit.astype("int16")
+            )
+        return st, stats
+
+    monkeypatch.setattr(engine, "gossip_round", broken)
+    findings = audit_contracts(names=["gossip_round_local"])
+    assert findings, "audit missed a deliberate registry-plane break"
+    assert all("growth" in f.message for f in findings)
 
 
 def test_crashed_check_is_a_finding(monkeypatch):

@@ -1,0 +1,148 @@
+"""Conformance: socket-mode bootstrap vs the sim growth engine.
+
+The reference's membership growth is seeds handing each registering peer
+a degree-preferential subset (compat/seed.py ``get_peer_subset``,
+subset_policy="powerlaw" — the corrected semantics of the reference's
+dead ``powerlaw_connect``). The growth engine (growth/) is the same
+process vectorized: per-round join batches attaching degree-
+preferentially inside the jitted round. Both bootstrap processes must
+therefore build the SAME KIND of topology — compared here on one
+degree-distribution statistic with tolerance, the curves-style contract
+of test_curves.py ("matching distributions, not traces").
+
+The socket side is a real localhost cluster: peers register one at a
+time through the seeds' rendezvous handout; the resulting topology is
+read from the seeds' replicated registry. The sim side grows a K4 clique
+to the same size at one admission per round (sequential, like
+registration). Both sides attach 3 edges per arrival, so the comparison
+pins the SHAPE the preferential bias produces — mean degree (edge
+accounting) and hub mass (the power-law signature).
+"""
+
+import asyncio
+import functools
+import socket as socketlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.compat.peer import PeerNode
+from tpu_gossip.compat.seed import SeedNode
+from tpu_gossip.compat.timing import ProtocolTiming
+from tpu_gossip.core.state import SwarmConfig, init_swarm
+from tpu_gossip.core.topology import build_csr, preferential_attachment
+from tpu_gossip.growth import compile_growth, pad_graph_for_growth
+from tpu_gossip.growth.engine import realized_degrees
+from tpu_gossip.sim.engine import simulate
+
+N_SWARM = 24  # final size, both transports
+ATTACH = 3  # seed subset_size == growth attach_m
+SCALE = 0.02
+TIMING = ProtocolTiming().scaled(SCALE)
+
+
+def asyncio_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        return asyncio.run(fn(*a, **kw))
+
+    return wrapper
+
+
+def free_ports(n):
+    socks = [socketlib.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def socket_bootstrap_degrees(tmp_path, n_peers) -> np.ndarray:
+    """Register ``n_peers`` through a 2-seed cluster (powerlaw subset
+    handout, ATTACH neighbors each) and return the peers' degree
+    sequence from the replicated seed registry."""
+    config = tmp_path / "config.txt"
+    config.write_text("")
+    ports = free_ports(2 + n_peers)
+    seeds = []
+    for p in ports[:2]:
+        s = SeedNode("127.0.0.1", p, str(config), timing=TIMING,
+                     subset_policy="powerlaw", subset_size=ATTACH,
+                     log_dir=str(tmp_path), rng_seed=0)
+        await s.start()
+        seeds.append(s)
+    await asyncio.sleep(TIMING.seed_reconnect_period * 1.5)
+    peers = []
+    try:
+        for p in ports[2:]:
+            node = PeerNode("127.0.0.1", p, str(config), timing=TIMING,
+                            log_dir=str(tmp_path))
+            await node.start()
+            peers.append(node)
+            await asyncio.sleep(TIMING.registration_settle * 2.5)
+        await asyncio.sleep(TIMING.heartbeat_period)  # topology replicates
+        topo = seeds[0].network_topology
+        addrs = [p.addr for p in peers]
+        return np.asarray([len(topo.get(a, ())) for a in addrs])
+    finally:
+        for n in peers + seeds:
+            await n.stop()
+
+
+def sim_growth_degrees(n_final, seed) -> np.ndarray:
+    """Grow a K4 clique to ``n_final`` at one admission per round —
+    the registration process, vectorized — and return the realized
+    degree sequence."""
+    n0 = ATTACH + 1
+    graph = build_csr(
+        n0, preferential_attachment(n0, m=ATTACH, use_native=False,
+                                    rng=np.random.default_rng(seed))
+    )
+    pg, exists = pad_graph_for_growth(graph, n_final)
+    cfg = SwarmConfig(n_peers=n_final, msg_slots=1, fanout=2, mode="push",
+                      rewire_slots=ATTACH)
+    st = init_swarm(pg, cfg, origins=[0], exists=jnp.asarray(exists),
+                    key=jax.random.key(seed))
+    gp = compile_growth(n_initial=n0, target=n_final, n_slots=n_final,
+                        joins_per_round=1, attach_m=ATTACH)
+    fin, _ = simulate(st, cfg, n_final - n0 + 1, None, "fused", None, gp)
+    assert int(np.asarray(fin.exists).sum()) == n_final
+    return np.asarray(
+        realized_degrees(fin.row_ptr, fin.exists, fin.rewired,
+                     fin.rewire_targets, fin.degree_credit)
+    )[: n_final]
+
+
+@asyncio_test
+async def test_socket_bootstrap_vs_growth_engine_degrees(tmp_path):
+    sock_deg = await socket_bootstrap_degrees(tmp_path, N_SWARM)
+    sim_degs = [sim_growth_degrees(N_SWARM, seed=s) for s in range(3)]
+
+    # every socket peer except the very first got a non-empty handout
+    assert (sock_deg > 0).sum() >= N_SWARM - 1
+
+    # edge accounting: both processes add ~ATTACH edges per arrival, so
+    # mean degrees agree within stochastic tolerance (the socket side's
+    # first registrant and dropped handouts shave a little)
+    sim_mean = np.median([d.mean() for d in sim_degs])
+    assert 0.6 * sim_mean <= sock_deg.mean() <= 1.4 * sim_mean, (
+        sock_deg.mean(), sim_mean,
+    )
+
+    # the preferential-attachment signature: early/hub nodes accumulate a
+    # disproportionate share of the edges on BOTH sides, and the hub mass
+    # (top-3 share of total degree) agrees within a band
+    def hub_share(d):
+        d = np.sort(d)[::-1]
+        return d[:3].sum() / max(d.sum(), 1)
+
+    sim_share = np.median([hub_share(d) for d in sim_degs])
+    assert abs(hub_share(sock_deg) - sim_share) <= 0.15, (
+        hub_share(sock_deg), sim_share,
+    )
+    # and both are genuinely skewed (a uniform handout would sit at 3/24)
+    assert hub_share(sock_deg) > 0.2
+    assert sim_share > 0.2

@@ -51,6 +51,7 @@ def test_jsonl_rows(setup):
     assert set(rows[0]) == {
         "round", "coverage", "msgs_sent", "n_infected", "n_alive", "n_declared_dead",
         "msgs_dropped", "msgs_held", "msgs_delivered",
+        "n_members", "degree_gamma",
     }
 
 

@@ -35,6 +35,11 @@ from tpu_gossip.core.matching_topology import (
     matching_powerlaw_graph,
     matching_powerlaw_graph_sharded,
 )
+from tpu_gossip.growth import (
+    CompiledGrowth,
+    compile_growth,
+    pad_graph_for_growth,
+)
 
 __version__ = "0.1.0"
 
@@ -51,4 +56,7 @@ __all__ = [
     "MatchingPlan",
     "matching_powerlaw_graph",
     "matching_powerlaw_graph_sharded",
+    "CompiledGrowth",
+    "compile_growth",
+    "pad_graph_for_growth",
 ]

@@ -138,6 +138,27 @@ def _chaos_scenario(n_slots: int, n_real: int):
     )
 
 
+def _growth_plan(n_slots: int, n_initial: int):
+    """A small compiled growth schedule so the growing round traces its
+    full structure (admission slice, Gumbel-top-k draw, registry
+    scatters) under the fixed-point contract — pinning the growth plane
+    exactly the way the chaos scenario pins ``fault_held``."""
+    import numpy as np
+
+    from tpu_gossip.growth import compile_growth
+
+    target = min(n_initial + 32, n_slots)
+    return compile_growth(
+        n_initial=n_initial,
+        target=target,
+        n_slots=n_slots,
+        joins_per_round=4,
+        attach_m=2,
+        admit_rows=np.arange(n_initial, target),
+        max_join_burst=4,
+    )
+
+
 def _stats_contract(stats, problems: list, leading=()) -> None:
     import jax.numpy as jnp
 
@@ -150,6 +171,9 @@ def _stats_contract(stats, problems: list, leading=()) -> None:
         "msgs_dropped": jnp.int32,
         "msgs_held": jnp.int32,
         "msgs_delivered": jnp.int32,
+        # membership / degree-evolution track (growth/)
+        "n_members": jnp.int32,
+        "degree_gamma": jnp.float32,
     }
     for field, dt in declared.items():
         leaf = getattr(stats, field, None)
@@ -342,6 +366,69 @@ def _check_gossip_round() -> list:
             continue
         _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
         _stats_contract(out_stats, problems)
+    # the GROWING round (growth/): admission slice + Gumbel-top-k +
+    # registry scatters must keep the round a state fixed point on every
+    # local delivery engine — a growth plane that reshapes or drops a
+    # registry leaf could never ride a scan/while carry or a checkpoint
+    for graph, plan, label in (
+        (ctx["dg"], None, "xla"),
+        (ctx["dg"], ctx["splan"], "pallas"),
+        (ctx["mg"], ctx["mplan"], "matching"),
+    ):
+        st, cfg = ctx["state_for"](
+            graph, 16, mode="push_pull", rewire_slots=2,
+        )
+        gp = _growth_plan(graph.n_pad, graph.n_pad - 40)
+        name = f"gossip_round[growth,{label}]"
+        try:
+            out_st, out_stats = jax.eval_shape(
+                lambda s, p=plan, g=gp: engine.gossip_round(
+                    s, cfg, p, growth=g
+                ),
+                st,
+            )
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
+            continue
+        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
+        _stats_contract(out_stats, problems)
+    return problems
+
+
+@audit_check("growth_registry_plane")
+def _check_growth_registry() -> list:
+    """The registry plane's DECLARED leaf specs: SwarmState must carry
+    join_round/admitted_by/degree_credit as int32 (N,) rows and init them
+    to the bootstrap-member convention — the fields every growth check,
+    checkpoint loader, and repartition fill assumes."""
+    import numpy as np
+
+    problems: list[str] = []
+    ctx = _ctx()
+    st, _ = ctx["state_for"](ctx["dg"], 1)
+    n = ctx["dg"].n_pad
+    for field in ("join_round", "admitted_by", "degree_credit"):
+        leaf = getattr(st, field, None)
+        if leaf is None:
+            problems.append(f"SwarmState lost registry field {field!r}")
+            continue
+        if tuple(leaf.shape) != (n,) or str(leaf.dtype) != "int32":
+            problems.append(
+                f"SwarmState.{field}: {tuple(leaf.shape)}/{leaf.dtype} != "
+                f"declared ({n},)/int32"
+            )
+    if not problems:
+        ex = np.asarray(st.exists)
+        jr = np.asarray(st.join_round)
+        if not (np.all(jr[ex] == 0) and np.all(jr[~ex] == -1)):
+            problems.append(
+                "init_swarm: join_round must be 0 on existing rows, -1 on "
+                "non-members (the admission cursor's convention)"
+            )
+        if np.asarray(st.admitted_by).max() != -1:
+            problems.append("init_swarm: admitted_by must start -1 (bootstrap)")
+        if np.asarray(st.degree_credit).any():
+            problems.append("init_swarm: degree_credit must start 0")
     return problems
 
 
@@ -521,6 +608,35 @@ def _check_dist() -> list:
             f"gossip_round_dist[matching,scenario]: abstract eval failed: "
             f"{e!r:.200}"
         )
+    # the GROWING mesh round — the membership half of the bit-identity
+    # contract must trace with the same state fixed point on the mesh
+    # (growth edges ride the re-wiring plane, so the config carries slots)
+    gp = _growth_plan(plan.n, plan.n - 40)
+    cfg_g = SwarmConfig(
+        n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull",
+        rewire_slots=2,
+    )
+    st_g = init_swarm(
+        g.as_padded_graph(), cfg_g, origins=[0], exists=g.exists,
+        key=jax.random.key(0),
+    )
+    try:
+        out_st, out_stats = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(
+                s, cfg_g, plan, mesh, growth=gp
+            ),
+            st_g,
+        )
+        _diff_specs(
+            "gossip_round_dist[matching,growth]",
+            _spec_tree(out_st), _spec_tree(st_g), problems,
+        )
+        _stats_contract(out_stats, problems)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[matching,growth]: abstract eval failed: "
+            f"{e!r:.200}"
+        )
     # bucketed-CSR engine over a partitioned host graph
     import numpy as np
 
@@ -550,6 +666,30 @@ def _check_dist() -> list:
     except Exception as e:  # noqa: BLE001
         problems.append(
             f"gossip_round_dist[bucketed]: abstract eval failed: {e!r:.200}"
+        )
+    # bucketed engine under an active growth schedule
+    cfg3 = SwarmConfig(
+        n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull",
+        rewire_slots=2,
+    )
+    st3 = mesh_mod.init_sharded_swarm(sg, relabeled, position, cfg3, origins=[0])
+    gp_b = _growth_plan(sg.n_pad, sg.n_pad - 40)
+    try:
+        out_st, out_stats = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(
+                s, cfg3, sg, mesh, growth=gp_b
+            ),
+            st3,
+        )
+        _diff_specs(
+            "gossip_round_dist[bucketed,growth]",
+            _spec_tree(out_st), _spec_tree(st3), problems,
+        )
+        _stats_contract(out_stats, problems)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[bucketed,growth]: abstract eval failed: "
+            f"{e!r:.200}"
         )
     return problems
 

@@ -34,7 +34,11 @@ def build_parser() -> argparse.ArgumentParser:
         "all_to_all collectives, bit-identical to the local round)",
     )
     p.add_argument("--gamma", type=float, default=2.5, help="power-law exponent (chung-lu)")
-    p.add_argument("--m", type=int, default=3, help="edges per new node (pa)")
+    p.add_argument(
+        "--m", type=int, default=3,
+        help="edges per new node (pa graph build; also the fresh edges "
+        "each --grow joiner attaches)",
+    )
     p.add_argument("--mode", choices=["push", "push_pull", "flood"], default="push")
     p.add_argument("--fanout", type=int, default=3)
     p.add_argument("--slots", type=int, default=16, help="hash-dedup message slots")
@@ -106,6 +110,30 @@ def build_parser() -> argparse.ArgumentParser:
         "docs/round_tail_profile.md",
     )
     p.add_argument(
+        "--grow", type=int, default=0, metavar="TARGET_N",
+        help="grow the swarm to TARGET_N peers while gossiping (growth/, "
+        "docs/growth_engine.md): per-round join batches are admitted "
+        "INSIDE the jitted round, each joiner attaching --m fresh edges "
+        "by preferential attachment over the current realized degree "
+        "vector (Gumbel-top-k from a dedicated PRNG stream — the "
+        "local/sharded bit-identity contract extends to growing swarms). "
+        "Composes with --scenario join_burst phases (admission waves) "
+        "and every delivery engine; node-scoped scenario sets stay "
+        "declared over the INITIAL --peers ids",
+    )
+    p.add_argument(
+        "--grow-rate", type=int, default=0, metavar="J",
+        help="joins admitted per round (default: sized so TARGET_N is "
+        "reached in about half of --rounds/--max-rounds)",
+    )
+    p.add_argument(
+        "--grow-capacity", type=int, default=0, metavar="CAP",
+        help="state capacity in peer slots (jit-static; >= TARGET_N; "
+        "default TARGET_N). Slots beyond the target stay reserved — "
+        "headroom for resuming the checkpoint into a later, larger "
+        "growth schedule without a state rebuild",
+    )
+    p.add_argument(
         "--scenario", type=str, default="", metavar="TOML",
         help="chaos scenario schedule (tpu_gossip/faults/, docs/"
         "fault_model.md): time-phased message loss, delivery delay, "
@@ -154,6 +182,17 @@ def main(argv: list[str] | None = None) -> int:
             # OSError: a typo'd path is as much a config error as a bad
             # schedule — same clean rejection, no traceback
             print(f"--scenario: {e}", file=sys.stderr)
+            if args.grow and "outside" in str(e):
+                # satellite of the growth plane: node sets bind to the
+                # INITIAL membership — grown peers have no stable
+                # scenario-addressable id, so declaring one is a config
+                # error here, not a shape failure inside jit
+                print(
+                    "note: with --grow, node-scoped scenario sets are "
+                    f"declared over the INITIAL --peers ids [0, {args.peers})"
+                    " — grown peers are not scenario-addressable",
+                    file=sys.stderr,
+                )
             return 2
         if args.profile_round > 0:
             print("--profile-round measures the fault-free round's stage "
@@ -166,6 +205,10 @@ def main(argv: list[str] | None = None) -> int:
                   "after the first rebuild (scalar loss/delay/full-swarm "
                   "churn phases are fine)", file=sys.stderr)
             return 2
+    grow_err = _validate_grow(args, spec)
+    if grow_err:
+        print(grow_err, file=sys.stderr)
+        return 2
     if args.profile_round > 0 and args.shard:
         print("--profile-round decomposes the LOCAL round (use "
               "experiments/dist_profile.py for the mesh engines)",
@@ -190,13 +233,30 @@ def main(argv: list[str] | None = None) -> int:
                   "the bucketed-CSR engine on the exported CSR",
                   file=sys.stderr)
             return 2
-        from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+        if args.grow:
+            # the sharded-layout builder at 1 shard: its growth_rows are
+            # reserved, class-gap capacity rows the pairing pipeline never
+            # touches — the ONE matching growth layout, local and mesh
+            from tpu_gossip.core.matching_topology import (
+                matching_powerlaw_graph_sharded,
+            )
 
-        dgraph, mplan = matching_powerlaw_graph(
-            args.peers, gamma=args.gamma,
-            fanout=None if args.mode == "flood" else args.fanout,
-            key=jax.random.key(args.seed),
-        )
+            dgraph, mplan = matching_powerlaw_graph_sharded(
+                args.peers, 1, gamma=args.gamma,
+                fanout=None if args.mode == "flood" else args.fanout,
+                key=jax.random.key(args.seed),
+                growth_rows=args.grow_capacity - args.peers,
+            )
+        else:
+            from tpu_gossip.core.matching_topology import (
+                matching_powerlaw_graph,
+            )
+
+            dgraph, mplan = matching_powerlaw_graph(
+                args.peers, gamma=args.gamma,
+                fanout=None if args.mode == "flood" else args.fanout,
+                key=jax.random.key(args.seed),
+            )
         graph, exists = dgraph.as_padded_graph(), dgraph.exists
     elif args.graph == "pa":
         edges = topology.preferential_attachment(args.peers, m=args.m, rng=rng)
@@ -209,6 +269,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.shard:
         return _main_shard(args, graph, rng, spec)
 
+    if args.grow and args.graph != "matching":
+        from tpu_gossip.growth import pad_graph_for_growth
+
+        graph, exists = pad_graph_for_growth(graph, args.grow_capacity)
+
     cfg = SwarmConfig(
         n_peers=graph.n,
         msg_slots=args.slots,
@@ -218,7 +283,7 @@ def main(argv: list[str] | None = None) -> int:
         sir_recover_rounds=args.sir_recover,
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
-        rewire_slots=args.rewire_slots,
+        rewire_slots=_rewire_slots(args),
         rewire_compact_cap=args.rewire_compact_cap,
     )
     plan = mplan
@@ -250,18 +315,19 @@ def main(argv: list[str] | None = None) -> int:
         return _main_profile_round(args, cfg, state, plan)
 
     scen = _compile_cli_scenario(spec, args, n_slots=graph.n)
+    grow = _compile_cli_growth(args, spec, n_slots=graph.n, mplan=mplan)
     with trace(args.profile):
         if args.remat_every > 0:
-            summary, fin = _run_with_remat(args, cfg, state, scen)
+            summary, fin = _run_with_remat(args, cfg, state, scen, grow)
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
             fin, stats = simulate(state, cfg, args.rounds, plan, args.tail,
-                                  scen)
+                                  scen, grow)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats, **_scenario_summary(spec, stats))
         else:
-            if scen is None:
+            if scen is None and grow is None:
                 result, fin = M.bench_swarm(
                     state, cfg, args.target, args.max_rounds, plan=plan,
                     tail=args.tail,
@@ -273,17 +339,111 @@ def main(argv: list[str] | None = None) -> int:
                     state, cfg, args.target, args.max_rounds,
                     run=lambda st: run_until_coverage(
                         st, cfg, args.target, args.max_rounds, plan=plan,
-                        tail=args.tail, scenario=scen,
+                        tail=args.tail, scenario=scen, growth=grow,
                     ),
                 )
             summary = {"summary": True, "mode": args.mode,
                        **_scenario_summary(spec),
                        **json.loads(result.to_json())}
+    summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
 
     if args.checkpoint:
         save_swarm(args.checkpoint, fin)
     return 0
+
+
+def _validate_grow(args, spec):
+    """Normalize + reject impossible --grow configs; returns an error
+    string (exit 2) or None. Mutates args: fills the rate/capacity
+    defaults so every engine path reads one settled config."""
+    if not args.grow:
+        if spec is not None and spec.uses_join_burst:
+            return ("--scenario: join_burst phases are admission waves for "
+                    "a growing run; add --grow")
+        return None
+    total_rounds = args.rounds if args.rounds > 0 else args.max_rounds
+    if args.grow <= args.peers:
+        return (f"--grow {args.grow} must exceed --peers {args.peers} "
+                "(the target is the grown swarm size)")
+    if args.grow_capacity == 0:
+        args.grow_capacity = args.grow
+    if args.grow_capacity < args.grow:
+        return (f"--grow-capacity {args.grow_capacity} below the growth "
+                f"target {args.grow}")
+    if args.grow_rate < 0:
+        return "--grow-rate must be >= 0"
+    if args.grow_rate == 0:
+        # default pace: reach the target in about half the horizon, so
+        # the grown swarm still gossips at full size for a while
+        args.grow_rate = max(
+            1, -(-(args.grow - args.peers) // max(total_rounds // 2, 1))
+        )
+    if args.m >= args.peers:
+        return (f"--m {args.m} fresh edges per joiner needs at least that "
+                f"many initial peers (--peers {args.peers})")
+    if args.profile_round > 0:
+        return "--profile-round measures the fixed-n round; drop --grow"
+    if args.shard and args.remat_every > 0:
+        return ("--grow cannot compose with --shard --remat-every: the "
+                "epoch re-partition permutes peers, so the compiled "
+                "admission schedule would admit the wrong rows after the "
+                "first rebuild (local --remat-every composes fine)")
+    return None
+
+
+def _rewire_slots(args) -> int:
+    """Growth edges ride the re-wiring plane: a growing config needs at
+    least --m target slots per row (growth/engine.apply_growth)."""
+    return max(args.rewire_slots, args.m) if args.grow else args.rewire_slots
+
+
+def _compile_cli_growth(args, spec, n_slots, mplan=None, node_map=None):
+    """Compile the --grow admission schedule for one engine's layout —
+    the growth twin of :func:`_compile_cli_scenario`."""
+    if not args.grow:
+        return None
+    from tpu_gossip.growth import compile_growth, matching_admit_rows
+
+    admit = None
+    if mplan is not None:
+        admit = matching_admit_rows(mplan, args.grow - args.peers)
+    return compile_growth(
+        n_initial=args.peers,
+        target=args.grow,
+        n_slots=n_slots,
+        joins_per_round=args.grow_rate,
+        attach_m=args.m,
+        admit_rows=admit,
+        node_map=node_map,
+        max_join_burst=spec.max_join_burst if spec is not None else 0,
+    )
+
+
+def _growth_summary(args, fin) -> dict:
+    """Final membership + degree-tail fields for a growing run's summary
+    (host-side, from the final state — every run shape has one)."""
+    if not args.grow:
+        return {}
+    from tpu_gossip.core.topology import fit_powerlaw_gamma
+    from tpu_gossip.growth.engine import realized_degrees
+
+    deg = np.asarray(realized_degrees(
+        fin.row_ptr, fin.exists, fin.rewired, fin.rewire_targets,
+        fin.degree_credit,
+    ))
+    live = np.asarray(fin.alive) & ~np.asarray(fin.declared_dead)
+    try:
+        gamma = round(fit_powerlaw_gamma(deg[live]), 4)
+    except ValueError:  # tail too thin (tiny swarms)
+        gamma = None
+    return {
+        "grow_target": args.grow,
+        "grow_rate": args.grow_rate,
+        "grow_capacity": args.grow_capacity,
+        "n_members": int(np.asarray(fin.exists).sum()),
+        "degree_gamma": gamma,
+    }
 
 
 def _compile_cli_scenario(
@@ -357,7 +517,7 @@ def _main_profile_round(args, cfg, state, plan) -> int:
     return 0
 
 
-def _run_with_remat(args, cfg, state, scen=None):
+def _run_with_remat(args, cfg, state, scen=None, grow=None):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
     The first re-materialization pads col_idx to the fixed capacity, so the
@@ -399,10 +559,10 @@ def _run_with_remat(args, cfg, state, scen=None):
 
     def run_segment(st, seg, plan):
         if args.rounds > 0:
-            return simulate(st, cfg, seg, plan, args.tail, scen)
+            return simulate(st, cfg, seg, plan, args.tail, scen, grow)
         return run_until_coverage(
             st, cfg, args.target, seg, plan=plan, tail=args.tail,
-            scenario=scen,
+            scenario=scen, growth=grow,
         ), None
 
     # warm EVERY shape the timed loop will see, on throwaway clones:
@@ -660,6 +820,10 @@ def _main_shard_matching(args, rng, spec=None) -> int:
         args.peers, mesh.size, gamma=args.gamma,
         fanout=None if args.mode == "flood" else args.fanout,
         key=jax.random.key(args.seed),
+        growth_rows=(
+            -(-(args.grow_capacity - args.peers) // mesh.size)
+            if args.grow else 0
+        ),
     )
     plan = shard_matching_plan(plan, mesh)
     cfg = SwarmConfig(
@@ -671,7 +835,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
         sir_recover_rounds=args.sir_recover,
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
-        rewire_slots=args.rewire_slots,
+        rewire_slots=_rewire_slots(args),
         rewire_compact_cap=args.rewire_compact_cap,
     )
     origins, silent_ids = _sample_ids(args, rng)
@@ -695,10 +859,11 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                       for s in range(mesh.size)],
         n_shards=mesh.size,
     )
+    grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
     with trace(args.profile):
         if args.rounds > 0:
             fin, stats = simulate_dist(state, cfg, plan, mesh, args.rounds,
-                                       None, scen)
+                                       None, scen, grow)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats, devices=mesh.size,
@@ -708,13 +873,14 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
                 run=lambda st: run_until_coverage_dist(
                     st, cfg, plan, mesh, args.target, args.max_rounds,
-                    scenario=scen,
+                    scenario=scen, growth=grow,
                 ),
             )
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
                        **_scenario_summary(spec),
                        **json.loads(result.to_json())}
+    summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
 
     if args.checkpoint:
@@ -741,6 +907,11 @@ def _main_shard(args, graph, rng, spec=None) -> int:
     from tpu_gossip.utils.profiling import trace
 
     mesh = make_mesh()
+    gexists = None
+    if args.grow:
+        from tpu_gossip.growth import pad_graph_for_growth
+
+        graph, gexists = pad_graph_for_growth(graph, args.grow_capacity)
     sg, relabeled, position = partition_graph(graph, mesh.size, seed=args.seed)
     cfg = SwarmConfig(
         n_peers=sg.n_pad,  # padded slot space; pads are born dead
@@ -751,13 +922,14 @@ def _main_shard(args, graph, rng, spec=None) -> int:
         sir_recover_rounds=args.sir_recover,
         churn_leave_prob=args.churn_leave,
         churn_join_prob=args.churn_join,
-        rewire_slots=args.rewire_slots,
+        rewire_slots=_rewire_slots(args),
         rewire_compact_cap=args.rewire_compact_cap,
     )
     plans = build_shard_plans(sg) if args.staircase else None
     origins, silent_ids = _sample_ids(args, rng)
     state = init_sharded_swarm(
-        sg, relabeled, position, cfg, key=jax.random.key(args.seed), origins=origins
+        sg, relabeled, position, cfg, key=jax.random.key(args.seed),
+        origins=origins, exists=gexists,
     )
     if silent_ids is not None:
         state.silent = state.silent.at[position[silent_ids]].set(True)
@@ -770,6 +942,10 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                       for s in range(mesh.size)],
         n_shards=mesh.size,
     )
+    grow = _compile_cli_growth(
+        args, spec, n_slots=sg.n_pad,
+        node_map=lambda ids: position[np.asarray(ids)],
+    )
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
@@ -778,7 +954,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
             fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
-                                       plans, scen)
+                                       plans, scen, grow)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats, devices=mesh.size,
@@ -791,12 +967,13 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
                 run=lambda st: run_until_coverage_dist(
                     st, cfg, sg, mesh, args.target, args.max_rounds,
-                    shard_plan=plans, scenario=scen,
+                    shard_plan=plans, scenario=scen, growth=grow,
                 ),
             )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
                        **_scenario_summary(spec),
                        **json.loads(result.to_json())}
+    summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
 
     if args.checkpoint:

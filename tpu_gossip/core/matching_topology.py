@@ -563,6 +563,7 @@ def matching_powerlaw_graph_sharded(
     key: jax.Array | None = None,
     interpret: bool | None = None,
     export_csr: bool = True,
+    growth_rows: int = 0,
 ) -> tuple[DeviceGraph, MatchingPlan]:
     """Structured-matching power-law swarm laid out for an ``n_shards`` mesh.
 
@@ -583,9 +584,14 @@ def matching_powerlaw_graph_sharded(
       Documented generator semantics, like the class pad waste and the
       swarm size rounding up to ``n_shards * n_per``;
     - state rows: shard s owns ``[s*n_blk, (s+1)*n_blk)`` with
-      ``n_blk = n_per + 1`` (one born-dead pad row per shard, so the state
-      stays mesh-divisible; the LAST pad row doubles as the CSR sentinel
-      absorbing erased edges);
+      ``n_blk = n_per + growth_rows + 1`` (one born-dead pad row per
+      shard, so the state stays mesh-divisible; the LAST pad row doubles
+      as the CSR sentinel absorbing erased edges). ``growth_rows`` extra
+      born-dead rows per block are GROWTH CAPACITY (growth/): degree-0,
+      outside every class table (expand/reduce skip them as node gaps, so
+      the static pipeline neither reads nor writes them), reserved for
+      in-round preferential-attachment admission — their traffic rides
+      the fresh-edge side paths, never the pairing pipeline;
     - slot rows: shard s owns ``[s*per_rows, (s+1)*per_rows)``, laid out
       by ONE shared ``local_classes`` table (every shard's degree sequence
       is identical, so the class plan is computed once). The plan's global
@@ -623,6 +629,8 @@ def matching_powerlaw_graph_sharded(
             f"n_shards={s} must divide 128 (the transpose all_to_all splits "
             "the lane axis)"
         )
+    if growth_rows < 0:
+        raise ValueError(f"growth_rows={growth_rows} must be >= 0")
     if d_max is None:
         d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
     n_per = -(-n // s)
@@ -636,7 +644,7 @@ def matching_powerlaw_graph_sharded(
     gran = 32 if n_slots_local * s >= (1 << 19) else 8
     per_rows = math.ceil(n_slots_local / (128 * gran)) * gran
     rows = per_rows * s
-    n_blk = n_per + 1
+    n_blk = n_per + growth_rows + 1
     n_state = s * n_blk
     classes = tuple(
         (sh * n_blk + no, sh * per_rows * 128 + so, c, pd, cs)

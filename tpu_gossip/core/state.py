@@ -132,6 +132,25 @@ class SwarmState:
     # scenario freezes the backlog; release it explicitly with
     # ``tpu_gossip.faults.drain_held(state)``.
     fault_held: jax.Array  # bool (N, M)
+    # membership registry plane (growth/): the vectorized twin of the
+    # reference seeds' per-peer registry (Seed.py:29-76) — one row per
+    # state slot, riding the pytree so mid-growth checkpoints resume
+    # bit-exactly. Rows admitted by the growth engine flip ``exists``
+    # live and record their bootstrap here; initial members carry
+    # join_round=0. ``degree_credit`` counts unfolded fresh IN-edges (+1
+    # per fresh edge pointing at the row — granted at admission and by
+    # churn re-wiring draws, released when an overwrite discards the
+    # edges); a row's fresh OUT side is read off its live
+    # ``rewire_targets`` instead of a second book, so the realized degree
+    # a preferential-attachment draw weighs is
+    # ``(rewired ? fresh_target_count : csr_degree) * exists + credit``
+    # (growth/engine.realized_degrees). rematerialize_rewired zeroes the
+    # credit when it folds the fresh edges into the CSR. Checkpoints that
+    # predate the plane load with it zeroed (join_round 0 on existing
+    # rows, -1 elsewhere) and capacity == n.
+    join_round: jax.Array  # int32 (N,) — round the slot joined (-1: never)
+    admitted_by: jax.Array  # int32 (N,) — admitting-seed row id (-1: bootstrap member)
+    degree_credit: jax.Array  # int32 (N,) — unfolded fresh in-edges (+1 each)
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -175,19 +194,28 @@ def load_swarm(path) -> SwarmState:
     which defaults to all-True — correct for their unpadded swarms).
     Named-format checkpoints that predate the scenario engine lack
     ``fault_held``; they load with it zeroed — faults disabled, exactly
-    their semantics when saved."""
+    their semantics when saved. Checkpoints that predate the growth
+    engine lack the registry plane (``join_round``/``admitted_by``/
+    ``degree_credit``); they load with it zeroed — every existing row a
+    bootstrap member, capacity == n, exactly their semantics when
+    saved."""
     data = np.load(path)
     kwargs = {}
+    _GROWTH_FIELDS = ("join_round", "admitted_by", "degree_credit")
     if any(k.startswith("field_") or k.startswith("prngkey_") for k in data.files):
         for f in dataclasses.fields(SwarmState):
             if f"prngkey_{f.name}" in data:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
-            elif f.name == "fault_held" and f"field_{f.name}" not in data:
-                continue  # pre-scenario checkpoint: zero-filled below
+            elif (
+                f.name == "fault_held" or f.name in _GROWTH_FIELDS
+            ) and f"field_{f.name}" not in data:
+                continue  # pre-scenario / pre-growth checkpoint: filled below
             else:
                 kwargs[f.name] = jnp.asarray(data[f"field_{f.name}"])
         if "fault_held" not in kwargs:
             kwargs["fault_held"] = jnp.zeros(kwargs["seen"].shape, dtype=bool)
+        if "join_round" not in kwargs:
+            kwargs.update(_zero_registry(kwargs["exists"]))
     else:  # legacy positional layout
         for i, name in enumerate(_V1_FIELDS):
             if f"key_{i}" in data:
@@ -210,7 +238,19 @@ def load_swarm(path) -> SwarmState:
         kwargs["rewired"] = jnp.zeros((n,), dtype=bool)
         kwargs["rewire_targets"] = jnp.zeros((n, 1), dtype=jnp.int32)
         kwargs["fault_held"] = jnp.zeros((n, m), dtype=bool)
+        kwargs.update(_zero_registry(kwargs["exists"]))
     return SwarmState(**kwargs)
+
+
+def _zero_registry(exists: jax.Array) -> dict:
+    """The registry plane a pre-growth checkpoint implies: every existing
+    row is a bootstrap member (join_round 0, no admitting seed), no growth
+    edges outstanding."""
+    return {
+        "join_round": jnp.where(exists, 0, -1).astype(jnp.int32),
+        "admitted_by": jnp.full(exists.shape, -1, dtype=jnp.int32),
+        "degree_credit": jnp.zeros(exists.shape, dtype=jnp.int32),
+    }
 
 
 def clone_state(state: SwarmState) -> SwarmState:
@@ -360,6 +400,11 @@ def init_swarm(
         rewired=jnp.zeros((n,), dtype=bool),
         rewire_targets=jnp.zeros((n, s), dtype=jnp.int32),
         fault_held=jnp.zeros((n, m), dtype=bool),
+        # registry plane: existing rows are bootstrap members (join round
+        # 0, no admitting seed); non-existent rows are admittable capacity
+        join_round=jnp.where(exists, 0, -1).astype(jnp.int32),
+        admitted_by=jnp.full((n,), -1, dtype=jnp.int32),
+        degree_credit=jnp.zeros((n,), dtype=jnp.int32),
         rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

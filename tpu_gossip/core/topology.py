@@ -41,6 +41,7 @@ __all__ = [
     "preferential_attachment",
     "build_csr",
     "edges_to_adjacency_sets",
+    "hill_gamma",
     "fit_powerlaw_gamma",
     "save_graph",
     "load_graph",
@@ -232,6 +233,16 @@ def edges_to_adjacency_sets(edges: np.ndarray) -> dict[int, set[int]]:
     return adj
 
 
+def hill_gamma(tail_count, log_moment):
+    """The ONE Hill/CSN estimator expression shared by the host fitter
+    (:func:`fit_powerlaw_gamma`) and the device-side running γ-MLE track
+    (growth/engine.py): ``1 + k / sum(log(d_i / (d_min - 1/2)))`` with
+    ``log_moment`` the pre-reduced continuity-corrected log sum. Pure
+    arithmetic — accepts numpy scalars or jax tracers alike (the
+    ``pareto_icdf`` precedent)."""
+    return 1.0 + tail_count / log_moment
+
+
 def fit_powerlaw_gamma(degrees: np.ndarray, d_min: int = 4) -> float:
     """Maximum-likelihood (Hill) estimate of the tail exponent of ``degrees``.
 
@@ -243,4 +254,4 @@ def fit_powerlaw_gamma(degrees: np.ndarray, d_min: int = 4) -> float:
     d = d[d >= d_min]
     if d.size < 10:
         raise ValueError("not enough tail samples to estimate gamma")
-    return float(1.0 + d.size / np.sum(np.log(d / (d_min - 0.5))))
+    return float(hill_gamma(d.size, np.sum(np.log(d / (d_min - 0.5)))))

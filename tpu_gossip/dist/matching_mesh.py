@@ -388,6 +388,7 @@ def gossip_round_dist_matching(
     plan: MatchingPlan,
     mesh: Mesh,
     scenario=None,
+    growth=None,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -399,7 +400,10 @@ def gossip_round_dist_matching(
     and every fault draw is made at global shape outside ``shard_map``.
     Churn re-wiring masks the static pipeline like the local kernel path
     and routes fresh-edge traffic through
-    ``sim.engine.fresh_rewire_traffic`` outside ``shard_map``.
+    ``sim.engine.fresh_rewire_traffic`` outside ``shard_map``. ``growth``
+    (growth/) admissions run in the shared ``advance_round`` at global
+    shape too, so a GROWING mesh round stays bit-identical to its local
+    twin — the membership extension of this engine's parity contract.
     """
     from tpu_gossip.sim.engine import (
         advance_round,
@@ -438,7 +442,7 @@ def gossip_round_dist_matching(
         )
         return advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive,
+            k_join, receptive, growth=growth,
         )
     from tpu_gossip.faults.inject import scenario_dissemination
 
@@ -454,5 +458,5 @@ def gossip_round_dist_matching(
     return advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem,
+        fault_held=held, fstats=telem, growth=growth,
     )

@@ -380,25 +380,38 @@ def init_sharded_swarm(
     key: jax.Array | None = None,
     origins: np.ndarray | list[int] | None = None,
     origin_slot: int = 0,
+    exists: np.ndarray | None = None,
 ) -> SwarmState:
     """SwarmState over the padded slot space; pad slots are born dead.
 
     ``cfg.n_peers`` must equal ``sg.n_pad``; ``origins`` are ORIGINAL peer
     ids (mapped through ``position``). Pad slots get ``alive=False`` and
     ``declared_dead=True`` so every protocol path ignores them (the detector
-    is idempotent on already-dead peers).
+    is idempotent on already-dead peers). ``exists`` (over ORIGINAL peer
+    ids, length ``sg.n``) marks real initial members — rows False start
+    as born-dead growth capacity (growth/pad_graph_for_growth reserves
+    them; admission flips them live), with ``join_round`` -1 like any
+    non-member slot.
     """
     if cfg.n_peers != sg.n_pad:
         raise ValueError(f"cfg.n_peers={cfg.n_peers} != n_pad={sg.n_pad}")
     mapped = None if origins is None else position[np.asarray(origins)]
     state = init_swarm(relabeled, cfg, key=key, origins=mapped, origin_slot=origin_slot)
-    if sg.n_pad > sg.n:
-        pad = np.zeros(sg.n_pad, dtype=bool)
-        pad[sg.n :] = True
-        pad = jnp.asarray(pad)
-        state.exists = state.exists & ~pad
-        state.alive = state.alive & ~pad
-        state.declared_dead = state.declared_dead | pad
+    dead = np.zeros(sg.n_pad, dtype=bool)
+    dead[sg.n :] = True
+    if exists is not None:
+        if np.asarray(exists).shape != (sg.n,):
+            raise ValueError(
+                f"exists covers {np.asarray(exists).shape} ids; the graph "
+                f"has {sg.n}"
+            )
+        dead[position[np.flatnonzero(~np.asarray(exists))]] = True
+    if dead.any():
+        dead = jnp.asarray(dead)
+        state.exists = state.exists & ~dead
+        state.alive = state.alive & ~dead
+        state.declared_dead = state.declared_dead | dead
+        state.join_round = jnp.where(dead, -1, state.join_round)
     return state
 
 
@@ -437,7 +450,10 @@ def repartition_swarm(
     # permuted — the remap below walks every dataclass leaf with leading
     # dim n instead of a hand-kept list, so new state cannot silently stay
     # in the old slot order
-    fills = {"declared_dead": True, "infected_round": -1, "rewire_targets": -1}
+    fills = {
+        "declared_dead": True, "infected_round": -1, "rewire_targets": -1,
+        "join_round": -1, "admitted_by": -1,
+    }
     topology_fields = {"row_ptr", "col_idx"}
 
     def remap(name, x):
@@ -445,10 +461,13 @@ def repartition_swarm(
         out = jnp.full((n_pad,) + x.shape[1:], fill, dtype=x.dtype)
         return out.at[pos].set(x)
 
-    # fresh targets are PEER IDS: map them through the permutation too
+    # fresh targets are PEER IDS: map them through the permutation too,
+    # as is the registry's admitting-seed column (growth/)
     tg = state.rewire_targets
     tg = jnp.where(tg >= 0, pos[jnp.clip(tg, 0, n - 1)], tg)
-    state = dataclasses.replace(state, rewire_targets=tg)
+    ab = state.admitted_by
+    ab = jnp.where(ab >= 0, pos[jnp.clip(ab, 0, n - 1)], ab)
+    state = dataclasses.replace(state, rewire_targets=tg, admitted_by=ab)
     updates = {
         f: remap(f, getattr(state, f))
         for f in type(state).__dataclass_fields__
@@ -744,6 +763,7 @@ def gossip_round_dist(
     mesh: Mesh,
     shard_plan: ShardPlans | None = None,
     scenario=None,
+    growth=None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -760,7 +780,9 @@ def gossip_round_dist(
     ``shard_map``, the same derived fault stream — so a scenario round
     stays bit-identical between a matching mesh run and its local twin,
     and distribution-equal for the bucketed engine (its baseline
-    contract)."""
+    contract). ``growth`` (growth/) admits join batches through the
+    shared ``advance_round`` stage with the same global-shape guarantee —
+    growing swarms keep each engine family's parity contract."""
     from tpu_gossip.core.matching_topology import MatchingPlan
 
     if isinstance(sg, MatchingPlan):
@@ -771,7 +793,7 @@ def gossip_round_dist(
                 "shard_plan=None"
             )
         return gossip_round_dist_matching(state, cfg, sg, mesh,
-                                          scenario=scenario)
+                                          scenario=scenario, growth=growth)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -789,7 +811,7 @@ def gossip_round_dist(
         )
         return advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive,
+            k_join, receptive, growth=growth,
         )
     from tpu_gossip.faults.inject import scenario_dissemination
 
@@ -805,7 +827,7 @@ def gossip_round_dist(
     return advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem,
+        fault_held=held, fstats=telem, growth=growth,
     )
 
 
@@ -822,6 +844,7 @@ def simulate_dist(
     num_rounds: int,
     shard_plan: ShardPlans | None = None,
     scenario=None,
+    growth=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -829,12 +852,13 @@ def simulate_dist(
     sharded per-peer buffers alias the output instead of being copied
     every call — pass ``clone_state(state)`` to keep the input alive.
     ``scenario`` threads a compiled fault schedule (faults/) through the
-    scan, exactly as in the local engine.
+    scan, exactly as in the local engine; ``growth`` threads a compiled
+    admission schedule (growth/) the same way.
     """
 
     def body(carry, _):
         nxt, stats = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
-                                       scenario)
+                                       scenario, growth)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -855,20 +879,23 @@ def run_until_coverage_dist(
     slot: int = 0,
     shard_plan: ShardPlans | None = None,
     scenario=None,
+    growth=None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
     DONATES ``state`` (see :func:`simulate_dist`); pass
     ``clone_state(state)`` to keep the input alive. ``scenario`` injects
     a compiled fault schedule (faults/); rounds past its horizon run
-    quiescent.
+    quiescent. ``growth`` admits join batches (growth/); rounds past its
+    schedule run fixed-n.
     """
 
     def cond(st: SwarmState) -> jax.Array:
         return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)
 
     def body(st: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan, scenario)
+        nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan, scenario,
+                                   growth)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

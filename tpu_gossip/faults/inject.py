@@ -77,6 +77,7 @@ class RoundFaults(NamedTuple):
     burst: jax.Array  # bool (N,) — rows the churn burst applies to
     blackout: jax.Array  # bool (N,) — rows cut off from the network
     group_b: jax.Array  # bool (N,) — partition side B (False = side A)
+    join_burst: jax.Array  # i32 — extra growth admissions this round (growth/)
 
 
 class FaultTelemetry(NamedTuple):
@@ -108,11 +109,17 @@ class CompiledScenario:
     burst: jax.Array  # bool (P+1, N)
     blackout: jax.Array  # bool (P+1, N)
     group_b: jax.Array  # bool (P+1, N)
+    # growth admission waves (growth/): extra joins/round per phase, on
+    # top of the growth schedule's base rate — zero table without
+    # join_burst phases. Meaningless without an active growth schedule
+    # (run_sim rejects the combination at parse time).
+    join_burst: jax.Array | None = None  # i32 (P+1,)
     name: str = dataclasses.field(default="scenario", metadata=dict(static=True))
     has_partition: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_blackout: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_churn: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_loss_delay: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_join_burst: bool = dataclasses.field(default=False, metadata=dict(static=True))
     n_rounds: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     def at_round(self, rnd: jax.Array) -> RoundFaults:
@@ -133,6 +140,11 @@ class CompiledScenario:
             burst=self.burst[ph],
             blackout=self.blackout[ph],
             group_b=self.group_b[ph],
+            join_burst=(
+                jnp.zeros((), dtype=jnp.int32)
+                if self.join_burst is None
+                else self.join_burst[ph]
+            ),
         )
 
 

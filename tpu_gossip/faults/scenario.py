@@ -180,6 +180,10 @@ class FaultPhase:
     churn_nodes: NodeSet = ALL_NODES
     partition: NodeSet | None = None  # group B of the split
     blackout: NodeSet | None = None
+    # admission wave (growth/): extra joins per round ON TOP of the
+    # active growth schedule's rate — composes churn storms with growth
+    # bursts. Requires a growing run (run_sim rejects it without --grow).
+    join_burst: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,6 +196,16 @@ class ScenarioSpec:
     @property
     def last_round(self) -> int:
         return max((p.end for p in self.phases), default=0)
+
+    @property
+    def max_join_burst(self) -> int:
+        """Largest per-round admission wave any phase adds — sizes the
+        growth engine's static batch shape (growth/plan.compile_growth)."""
+        return max((p.join_burst for p in self.phases), default=0)
+
+    @property
+    def uses_join_burst(self) -> bool:
+        return any(p.join_burst for p in self.phases)
 
     @property
     def uses_node_sets(self) -> bool:
@@ -236,6 +250,10 @@ class ScenarioSpec:
                     raise ScenarioError(
                         f"{w}: {field}={v} outside [0, 1]"
                     )
+            if p.join_burst < 0:
+                raise ScenarioError(
+                    f"{w}: join_burst={p.join_burst} must be >= 0"
+                )
             p.churn_nodes.validate(n_peers, n_shards, f"{w}.churn_nodes")
             if p.partition is not None:
                 p.partition.validate(n_peers, n_shards, f"{w}.partition")
@@ -385,7 +403,7 @@ def _node_set(v, where: str) -> NodeSet:
 
 _PHASE_KEYS = {
     "name", "start", "end", "loss", "delay", "churn_leave", "churn_join",
-    "churn_nodes", "partition", "blackout",
+    "churn_nodes", "partition", "blackout", "join_burst",
 }
 
 
@@ -427,6 +445,7 @@ def scenario_from_dict(d: dict) -> ScenarioSpec:
                     if p.get("blackout") is None
                     else _node_set(p["blackout"], f"phase {name!r}.blackout")
                 ),
+                join_burst=int(p.get("join_burst", 0)),
             )
         )
     return ScenarioSpec(
@@ -478,6 +497,7 @@ def compile_scenario(
     delay = np.zeros(n_ph + 1, dtype=np.float32)
     leave = np.zeros(n_ph + 1, dtype=np.float32)
     join = np.zeros(n_ph + 1, dtype=np.float32)
+    jburst = np.zeros(n_ph + 1, dtype=np.int32)
     burst = np.zeros((n_ph + 1, n_slots), dtype=bool)
     blackout = np.zeros((n_ph + 1, n_slots), dtype=bool)
     group_b = np.zeros((n_ph + 1, n_slots), dtype=bool)
@@ -488,6 +508,7 @@ def compile_scenario(
         delay[i] = p.delay
         leave[i] = p.churn_leave
         join[i] = p.churn_join
+        jburst[i] = p.join_burst
         if p.churn_leave or p.churn_join:
             burst[i] = p.churn_nodes.resolve(
                 n_peers, n_slots, node_map, shard_ranges
@@ -510,10 +531,12 @@ def compile_scenario(
         burst=jnp.asarray(burst),
         blackout=jnp.asarray(blackout),
         group_b=jnp.asarray(group_b),
+        join_burst=jnp.asarray(jburst) if spec.uses_join_burst else None,
         name=spec.name,
         has_partition=any(p.partition is not None for p in spec.phases),
         has_blackout=any(p.blackout is not None for p in spec.phases),
         has_churn=any(p.churn_leave or p.churn_join for p in spec.phases),
         has_loss_delay=any(p.loss or p.delay for p in spec.phases),
+        has_join_burst=spec.uses_join_burst,
         n_rounds=total_rounds,
     )

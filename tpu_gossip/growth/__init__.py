@@ -1,0 +1,36 @@
+"""Growth engine: in-round preferential-attachment joins.
+
+The reference's defining behavior — seeds bootstrapping new peers into a
+power-law topology by degree-preferential subset handout (Seed.py
+``get_peer_subset`` + demonstrate_powerlaw.py) — as a vectorized
+membership plane inside the jitted round: swarms GROW while gossiping, at
+jit-static capacity, bit-identically on the local and sharded engines.
+See docs/growth_engine.md for the admission semantics, capacity model,
+PRNG stream layout, and determinism contract.
+"""
+
+from tpu_gossip.growth.engine import (
+    GROWTH_STREAM_SALT,
+    apply_growth,
+    hill_gamma_device,
+    realized_degrees,
+)
+from tpu_gossip.growth.plan import (
+    CompiledGrowth,
+    GrowthError,
+    compile_growth,
+    matching_admit_rows,
+    pad_graph_for_growth,
+)
+
+__all__ = [
+    "GROWTH_STREAM_SALT",
+    "CompiledGrowth",
+    "GrowthError",
+    "apply_growth",
+    "compile_growth",
+    "hill_gamma_device",
+    "matching_admit_rows",
+    "pad_graph_for_growth",
+    "realized_degrees",
+]

@@ -1,0 +1,228 @@
+"""In-round admission: the growth engine's on-device half.
+
+One call to :func:`apply_growth` admits one round's join batch INSIDE the
+jitted round, as the row-level stage of ``sim.engine.advance_round`` —
+shared by all three delivery engines, so the membership plane exists
+once and cannot drift between them:
+
+- the batch size is ``joins_per_round`` plus any active scenario phase's
+  ``join_burst`` (faults/scenario.py), clipped to the remaining schedule;
+- each joiner draws ``attach_m`` DISTINCT target neighbors by
+  preferential attachment over the current REALIZED degree vector
+  (static CSR degree of existing rows + outstanding growth-edge credit)
+  via Gumbel-top-k over masked log-degrees: ``argtop_k(log deg + G)``
+  samples k items without replacement with probability proportional to
+  degree — the exponential-race formulation of the reference's intended
+  ``powerlaw_connect`` semantics, with no data-dependent shapes;
+- the draw comes from ``fold_in(state.rng, GROWTH_STREAM_SALT)`` at
+  GLOBAL shape — a derivation parallel to the protocol's 5-way split and
+  the fault stream's ``FAULT_STREAM_SALT``, never overlapping either —
+  so the local ↔ sharded bit-identity contract extends to growing swarms
+  (every growth op is elementwise/scatter at global shape outside
+  ``shard_map``; XLA's SPMD partitioner inserts the collectives), and a
+  zero-join growth config reproduces the fixed-n trajectory bit for bit;
+- the admitted rows flip ``exists``/``alive`` live, record their
+  bootstrap in the registry plane (``join_round``, ``admitted_by`` = the
+  top-scored attachment target — the hub that bootstrapped the peer,
+  the vectorized twin of the reference seed's subset handout), and their
+  fresh edges ride the EXISTING churn re-wiring plane
+  (``rewired``/``rewire_targets``): delivery over fresh edges, the
+  bidirectional reverse push, the compact O(cap) side paths, and
+  ``rematerialize_rewired``'s CSR fold all apply to growth edges
+  unchanged, on every engine.
+
+Batch-admission approximation (documented generator semantics): joiners
+in one round's batch attach to the pre-batch membership — two same-round
+joiners never pick each other, exactly like the reference's registration
+window (a registering peer's subset comes from the seed's CURRENT
+registry, Seed.py:127-129).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_gossip.core.topology import hill_gamma
+
+__all__ = [
+    "GROWTH_STREAM_SALT",
+    "realized_degrees",
+    "hill_gamma_device",
+    "apply_growth",
+]
+
+# folds the round's root key (state.rng) into the growth stream — a
+# derivation parallel to the protocol's 5-way split and the fault
+# stream's FAULT_STREAM_SALT (0x5CE7A510), overlapping neither
+GROWTH_STREAM_SALT = 0x9087A110
+
+
+def realized_degrees(
+    row_ptr: jax.Array,
+    exists: jax.Array,
+    rewired: jax.Array,
+    rewire_targets: jax.Array,
+    degree_credit: jax.Array,
+) -> jax.Array:
+    """The degree vector a preferential-attachment draw weighs.
+
+    A row's OUT side is read off the live tables, never a second book
+    that could go stale: a re-wired row (growth joiner or churn rejoiner
+    — its static CSR row is stale) counts its valid fresh targets;
+    everyone else counts their CSR degree. The IN side of unfolded fresh
+    edges is ``SwarmState.degree_credit`` (+1 per fresh edge pointing at
+    the row, maintained by admission and by the churn re-wiring
+    overwrite; ``rematerialize_rewired`` zeroes it as it folds the edges
+    into the CSR). Exact for every re-wired row; a non-rewired row can
+    over-count by its stale CSR edges into re-wired rows until the fold
+    drops them — the same stale-edge class the delivery masks handle.
+    """
+    base = row_ptr[1:] - row_ptr[:-1]
+    fresh = jnp.sum(rewire_targets >= 0, axis=1, dtype=jnp.int32)
+    own = jnp.where(rewired, fresh, base.astype(jnp.int32))
+    return jnp.where(exists, own, 0) + degree_credit
+
+
+def hill_gamma_device(
+    deg: jax.Array, live: jax.Array, d_min: int
+) -> jax.Array:
+    """Running γ-MLE over the live degree vector (the degree-evolution
+    track): the SAME Hill/CSN estimator as
+    ``core.topology.fit_powerlaw_gamma`` (shared ``hill_gamma``
+    arithmetic), computed as two masked reductions so it rides the round
+    on device. Returns 0.0 when the tail is too thin to estimate (< 10
+    samples — the host fitter raises there instead).
+
+    Determinism note: this is the ONE float reduction in the growth
+    plane, and XLA brackets a sharded sum per shard — so across engine
+    layouts the track agrees to float32 reduction tolerance (observed
+    1 ULP), while the state trajectory and every integer stat stay
+    bit-exact. Tests pin the state bitwise and this track to allclose.
+    """
+    tail = live & (deg >= d_min)
+    k = jnp.sum(tail, dtype=jnp.int32)
+    logs = jnp.where(
+        tail,
+        jnp.log(jnp.maximum(deg, 1).astype(jnp.float32) / (d_min - 0.5)),
+        0.0,
+    )
+    s = jnp.sum(logs, dtype=jnp.float32)
+    return jnp.where(
+        (k >= 10) & (s > 0), hill_gamma(k, s), 0.0
+    ).astype(jnp.float32)
+
+
+def apply_growth(
+    growth,
+    rng: jax.Array,
+    rnd: jax.Array,
+    join_burst: jax.Array,
+    *,
+    row_ptr: jax.Array,
+    exists: jax.Array,
+    alive: jax.Array,
+    silent: jax.Array,
+    last_hb: jax.Array,
+    declared_dead: jax.Array,
+    rewired: jax.Array,
+    rewire_targets: jax.Array,
+    join_round: jax.Array,
+    admitted_by: jax.Array,
+    degree_credit: jax.Array,
+) -> dict:
+    """Admit one round's join batch; returns the updated row-level fields.
+
+    ``rng`` is the round's ROOT key (``state.rng``) — the growth stream
+    derives from it by ``fold_in`` and consumes nothing of the protocol's
+    5-way split. ``join_burst`` is the active scenario phase's extra
+    admissions (0 without one). All shapes are static
+    (``growth.max_batch`` rows drawn every round regardless of the
+    traced take count — stream positions depend only on the round, so
+    schedule edits never shift later rounds' randomness), and a round
+    with nothing left to admit is a masked no-op.
+    """
+    if growth.attach_m > rewire_targets.shape[1]:
+        raise ValueError(
+            f"growth.attach_m={growth.attach_m} exceeds the state's "
+            f"rewire_targets width {rewire_targets.shape[1]} — growth "
+            "edges ride the re-wiring plane; build the config with "
+            f"rewire_slots >= {growth.attach_m}"
+        )
+    n = exists.shape[0]
+    jb, m = growth.max_batch, growth.attach_m
+
+    # schedule cursor: how many the state says are already admitted
+    n_adm = jnp.sum(growth.growable & exists, dtype=jnp.int32)
+    quota = growth.joins_per_round + join_burst.astype(jnp.int32)
+    take = jnp.clip(jnp.minimum(quota, growth.total - n_adm), 0, jb)
+    rows = jax.lax.dynamic_slice(growth.admit_rows, (n_adm,), (jb,))
+    batch_live = jnp.arange(jb) < take
+
+    # Gumbel-top-k preferential attachment over the realized degrees of
+    # CURRENT members (this batch's rows still have exists=False, so
+    # same-round joiners are never candidates, nor are pads or capacity)
+    deg = realized_degrees(row_ptr, exists, rewired, rewire_targets,
+                           degree_credit)
+    attach_ok = exists & alive & ~declared_dead & (deg > 0)
+    log_deg = jnp.where(
+        attach_ok, jnp.log(jnp.maximum(deg, 1).astype(jnp.float32)), -jnp.inf
+    )
+    k_grow = jax.random.fold_in(rng, GROWTH_STREAM_SALT)
+    gumbel = jax.random.gumbel(k_grow, (jb, n), dtype=jnp.float32)
+    scores, targets = jax.lax.top_k(log_deg[None, :] + gumbel, m)  # (jb, m)
+    t_valid = batch_live[:, None] & jnp.isfinite(scores)
+    targets = targets.astype(jnp.int32)
+    # the admitting seed is the TOP-scored target (column 0), extracted
+    # by a full-width masked reduction rather than targets[:, 0]: XLA's
+    # top-k simplifier rewrites a slice-of-top_k into a variadic argmax
+    # reduce whose scalar CPU lowering is ~40x the whole top_k (measured
+    # 757 ms vs 18 ms at (128, 32k)) — and guarding the slice with an
+    # optimization_barrier instead crashes the CPU TopkDecomposer (it
+    # casts every top_k user to get-tuple-element)
+    col0 = (jnp.arange(m) == 0)[None, :]
+    seed_id = jnp.sum(jnp.where(col0, targets, 0), axis=1)
+    seed_ok = jnp.sum(jnp.where(col0 & t_valid, 1, 0), axis=1) > 0
+
+    # registry + liveness flips, scattered at the batch rows (row `n` is
+    # the drop row for the dead tail of the batch)
+    sel = jnp.where(batch_live, rows, n)
+    exists = exists.at[sel].set(True, mode="drop")
+    alive = alive.at[sel].set(True, mode="drop")
+    silent = silent.at[sel].set(False, mode="drop")
+    declared_dead = declared_dead.at[sel].set(False, mode="drop")
+    last_hb = last_hb.at[sel].set(rnd, mode="drop")
+    join_round = join_round.at[sel].set(rnd, mode="drop")
+    admitted_by = admitted_by.at[sel].set(
+        jnp.where(seed_ok, seed_id, -1), mode="drop"
+    )
+
+    # fresh edges onto the re-wiring plane: the joiner's traffic rides
+    # fresh_rewire_traffic / reverse_fresh_push exactly like a churn
+    # rejoiner's, and rematerialize_rewired folds the edges into the CSR
+    width = rewire_targets.shape[1]
+    fresh_tg = jnp.full((jb, width), -1, dtype=rewire_targets.dtype)
+    fresh_tg = fresh_tg.at[:, :m].set(jnp.where(t_valid, targets, -1))
+    rewired = rewired.at[sel].set(True, mode="drop")
+    rewire_targets = rewire_targets.at[sel].set(fresh_tg, mode="drop")
+
+    # degree credit: +1 at each target — the IN side of the fresh edges.
+    # The joiner's OWN side is read off its rewire_targets by
+    # realized_degrees (no second book), so the realized degree vector
+    # sees both endpoints of every growth edge until the CSR fold
+    # materializes them and zeroes the credit
+    flat_t = jnp.where(t_valid, targets, n).reshape(-1)
+    degree_credit = degree_credit.at[flat_t].add(1, mode="drop")
+
+    return dict(
+        exists=exists,
+        alive=alive,
+        silent=silent,
+        last_hb=last_hb,
+        declared_dead=declared_dead,
+        rewired=rewired,
+        rewire_targets=rewire_targets,
+        join_round=join_round,
+        admitted_by=admitted_by,
+        degree_credit=degree_credit,
+    )

@@ -1,0 +1,194 @@
+"""Growth plans: capacity layout + admission schedule, compiled host-side.
+
+A growing swarm runs at jit-static CAPACITY: the state is built with more
+rows than live peers (the exists-mask machinery that already carries
+churned and pad rows), and the growth engine flips reserved rows live in
+per-round batches. Which rows are reserved — and in what admission order
+— depends on the engine's slot layout, exactly like the scenario
+compiler's node masks (faults/scenario.py):
+
+- **flat** layouts (the local XLA/staircase engines, any host CSR padded
+  by :func:`pad_graph_for_growth`): capacity rows are appended after the
+  initial peers; admission order is row order.
+- **sharded matching** layouts
+  (``matching_powerlaw_graph_sharded(growth_rows=...)``): each shard
+  block carries its own reserved rows; admission round-robins across
+  shards so the mesh stays balanced while it grows.
+- **bucketed mesh** layouts (``partition_graph`` over a padded CSR): the
+  load-balance permutation scatters capacity rows over shards; admission
+  order follows the ORIGINAL peer ids mapped through ``position``.
+
+All three are expressed through one ``admit_rows`` array — the j-th
+admitted peer's state row — so the engine half (growth/engine.py) is
+layout-blind, and a local and a sharded run that share a layout admit
+identical rows in identical order (the bit-identity contract's membership
+extension).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+__all__ = [
+    "GrowthError",
+    "CompiledGrowth",
+    "compile_growth",
+    "pad_graph_for_growth",
+    "matching_admit_rows",
+]
+
+
+class GrowthError(ValueError):
+    """A growth config that cannot mean what it says (compile time)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CompiledGrowth:
+    """An admission schedule compiled to device tables.
+
+    ``admit_rows`` lists the state row of every growth slot in admission
+    order, padded with an out-of-range drop row to ``total + max_batch``
+    entries so the per-round dynamic slice never clamps; ``growable``
+    marks exactly the rows ``admit_rows`` names, so
+    ``sum(growable & exists)`` IS the number of peers admitted so far —
+    the schedule cursor lives in the state, not in host bookkeeping, and
+    a mid-growth checkpoint resumes exactly where it stopped. Static
+    fields decide trace structure (batch shape, attachment width); traced
+    tables carry the layout.
+    """
+
+    admit_rows: jax.Array  # int32 (total + max_batch,) — drop-row padded
+    growable: jax.Array  # bool (N,) — rows the schedule may admit
+    joins_per_round: int = dataclasses.field(metadata=dict(static=True))
+    max_batch: int = dataclasses.field(metadata=dict(static=True))
+    attach_m: int = dataclasses.field(metadata=dict(static=True))
+    total: int = dataclasses.field(metadata=dict(static=True))
+    gamma_d_min: int = dataclasses.field(default=4, metadata=dict(static=True))
+
+
+def pad_graph_for_growth(graph, capacity: int):
+    """Pad a host CSR Graph to ``capacity`` rows of growth headroom.
+
+    Returns ``(padded_graph, exists)``: rows past ``graph.n`` are
+    degree-0 (no static edges — an admitted peer's links are the fresh
+    preferential-attachment edges the growth engine draws) and start
+    non-existent. Works for the local engines directly and for
+    ``partition_graph`` (the bucketed mesh), whose permutation spreads
+    the degree-0 capacity rows across shards.
+    """
+    from tpu_gossip.core.topology import Graph
+
+    n = graph.n
+    if capacity < n:
+        raise GrowthError(f"capacity {capacity} < initial peers {n}")
+    if capacity == n:
+        return graph, np.ones(n, dtype=bool)
+    row_ptr = np.concatenate([
+        graph.row_ptr,
+        np.full(capacity - n, graph.row_ptr[-1], dtype=graph.row_ptr.dtype),
+    ])
+    exists = np.zeros(capacity, dtype=bool)
+    exists[:n] = True
+    return Graph(n=capacity, row_ptr=row_ptr, col_idx=graph.col_idx), exists
+
+
+def matching_admit_rows(plan, total: int) -> np.ndarray:
+    """Admission-ordered state rows for a matching layout built with
+    ``matching_powerlaw_graph_sharded(..., growth_rows=...)``.
+
+    Each shard block holds ``growth_rows`` reserved rows at block offsets
+    ``[n_per, n_per + growth_rows)``; admission round-robins across
+    shards so the mesh stays balanced while it grows. The SAME rows in
+    the same order on the local and sharded runs of one plan — the
+    bit-identity contract's membership half.
+    """
+    s, n_blk, n_per = plan.mesh_shards, plan.n_blk, plan.n_per
+    per_shard = n_blk - n_per - 1  # reserved rows per block (pad row excluded)
+    if total > per_shard * s:
+        raise GrowthError(
+            f"schedule admits {total} peers but the matching layout "
+            f"reserves only {per_shard * s} growth rows — rebuild with "
+            f"growth_rows >= {-(-total // s)}"
+        )
+    j = np.arange(total, dtype=np.int64)
+    return (j % s) * n_blk + n_per + j // s
+
+
+def compile_growth(
+    *,
+    n_initial: int,
+    target: int,
+    n_slots: int,
+    joins_per_round: int,
+    attach_m: int,
+    admit_rows: np.ndarray | None = None,
+    node_map=None,
+    max_join_burst: int = 0,
+    gamma_d_min: int = 4,
+) -> "CompiledGrowth":
+    """Compile an admission schedule for one engine's slot layout.
+
+    ``target - n_initial`` peers will be admitted. ``admit_rows``
+    (admission-ordered state rows) defaults to the flat layout
+    ``[n_initial, target)``; ``node_map`` (an id→row callable, the same
+    hook the scenario compiler takes) maps that default through an
+    engine's permutation instead. ``max_join_burst`` sizes the static
+    per-round batch for the largest ``join_burst`` any scenario phase can
+    add on top of ``joins_per_round``. Validates as a precondition —
+    impossible schedules are config errors before anything traces.
+    """
+    import jax.numpy as jnp
+
+    total = int(target) - int(n_initial)
+    if total < 0:
+        raise GrowthError(
+            f"growth target {target} below initial peers {n_initial}"
+        )
+    if joins_per_round < 0 or max_join_burst < 0:
+        raise GrowthError("joins_per_round and join bursts must be >= 0")
+    if total > 0 and joins_per_round + max_join_burst <= 0:
+        raise GrowthError(
+            f"{total} peers to admit but joins_per_round=0 and no "
+            "join_burst phase — the swarm would never grow"
+        )
+    if attach_m <= 0:
+        raise GrowthError(f"attach_m={attach_m} must be positive")
+    if attach_m >= max(n_initial, 1):
+        raise GrowthError(
+            f"attach_m={attach_m} needs at least that many initial peers "
+            f"to attach to (got {n_initial})"
+        )
+    if admit_rows is None:
+        admit_rows = np.arange(n_initial, target, dtype=np.int64)
+        if node_map is not None and total:
+            admit_rows = np.asarray(node_map(admit_rows))
+    admit_rows = np.asarray(admit_rows, dtype=np.int64)
+    if admit_rows.shape != (total,):
+        raise GrowthError(
+            f"admit_rows has {admit_rows.shape} entries; the schedule "
+            f"admits {total}"
+        )
+    if total and (admit_rows.min() < 0 or admit_rows.max() >= n_slots):
+        raise GrowthError(
+            f"admit_rows outside the state's [0, {n_slots}) row space"
+        )
+    if len(np.unique(admit_rows)) != total:
+        raise GrowthError("admit_rows admits some row twice")
+    max_batch = max(joins_per_round + max_join_burst, 1)
+    growable = np.zeros(n_slots, dtype=bool)
+    growable[admit_rows] = True
+    padded = np.full(total + max_batch, n_slots, dtype=np.int32)  # drop row
+    padded[:total] = admit_rows
+    return CompiledGrowth(
+        admit_rows=jnp.asarray(padded),
+        growable=jnp.asarray(growable),
+        joins_per_round=int(joins_per_round),
+        max_batch=int(max_batch),
+        attach_m=int(attach_m),
+        total=int(total),
+        gamma_d_min=int(gamma_d_min),
+    )

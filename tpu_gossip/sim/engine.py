@@ -69,13 +69,32 @@ class RoundStats(NamedTuple):
     msgs_dropped: jax.Array  # i32 — deliveries eaten by the loss fault
     msgs_held: jax.Array  # i32 — deliveries sitting in the delay buffer
     msgs_delivered: jax.Array  # i32 — deliveries landed through loss/delay
+    # membership / degree-evolution track (growth/) — n_members counts
+    # every admitted slot (bootstrap + grown, churned-but-member included);
+    # degree_gamma is the running γ-MLE over the live realized degree
+    # vector, computed only when a growth schedule is active (0 otherwise:
+    # the per-round log sweep is priced only on growing runs)
+    n_members: jax.Array  # i32 — slots with exists=True
+    degree_gamma: jax.Array  # f32 — running Hill γ-MLE (0 when off/thin tail)
 
 
 def _stats(
-    state: SwarmState, msgs_sent: jax.Array, fstats=None
+    state: SwarmState, msgs_sent: jax.Array, fstats=None, growth=None
 ) -> RoundStats:
     live = state.alive & ~state.declared_dead
     z = jnp.zeros((), dtype=jnp.int32)
+    if growth is None:
+        gamma = jnp.zeros((), dtype=jnp.float32)
+    else:
+        from tpu_gossip.growth.engine import hill_gamma_device, realized_degrees
+
+        gamma = hill_gamma_device(
+            realized_degrees(
+                state.row_ptr, state.exists, state.rewired,
+                state.rewire_targets, state.degree_credit,
+            ),
+            live, growth.gamma_d_min,
+        )
     return RoundStats(
         coverage=state.coverage(0),  # the one coverage definition (state.py)
         msgs_sent=msgs_sent.astype(jnp.int32),
@@ -85,6 +104,8 @@ def _stats(
         msgs_dropped=z if fstats is None else fstats.msgs_dropped,
         msgs_held=z if fstats is None else fstats.msgs_held,
         msgs_delivered=z if fstats is None else fstats.msgs_delivered,
+        n_members=jnp.sum(state.exists).astype(jnp.int32),
+        degree_gamma=gamma,
     )
 
 
@@ -564,6 +585,11 @@ def rematerialize_rewired(
         col_idx=new_col,
         rewired=jnp.zeros_like(state.rewired),
         rewire_targets=jnp.full_like(state.rewire_targets, -1),
+        # growth-edge credit is now materialized in the CSR: the folded
+        # fresh edges appear in both endpoints' row_ptr degrees, so the
+        # realized-degree vector (growth/engine.realized_degrees) must
+        # stop double-counting them
+        degree_credit=jnp.zeros_like(state.degree_credit),
     )
     return new_state, overflow
 
@@ -657,8 +683,10 @@ def advance_round(
     churn_faults: bool = False,
     fault_held: jax.Array | None = None,
     fstats=None,
+    growth=None,
 ) -> tuple[SwarmState, RoundStats]:
-    """Everything after dissemination: dedup-merge, SIR, liveness, churn.
+    """Everything after dissemination: dedup-merge, SIR, liveness, churn,
+    growth admission.
 
     Shared by the local round (:func:`gossip_round`) and the multi-chip
     round (dist/mesh.py) so the protocol state machine exists exactly once.
@@ -683,6 +711,16 @@ def advance_round(
     SAME draw shapes, so engines stay bit-identical and a quiescent phase
     changes nothing. ``fault_held`` is the delay buffer to carry
     (defaults to the input's), ``fstats`` the round's fault telemetry.
+
+    ``growth`` (a :class:`~tpu_gossip.growth.CompiledGrowth`) admits this
+    round's join batch AFTER the churn draws (growth/engine.apply_growth:
+    preferential-attachment targets from the dedicated
+    ``fold_in(state.rng, GROWTH_STREAM_SALT)`` stream at global shape —
+    the protocol's 5-way split and the churn/fault draws are untouched,
+    so ``growth=None`` and an exhausted or zero-join schedule reproduce
+    the fixed-n trajectory bit for bit). Admitted rows' slot arrays are
+    already virgin (a never-existed row was never receptive), so the
+    fused tail needs no extra reset sweep for them.
     """
     # --- liveness (row-level) ---------------------------------------------
     # a blacked-out node is cut off from the heartbeat plane too: it emits
@@ -710,6 +748,7 @@ def advance_round(
     silent = state.silent
     rewired = state.rewired
     rewire_targets = state.rewire_targets
+    degree_credit = state.degree_credit
     fresh = None
     burst = faults is not None and churn_faults
     if cfg.churn_leave_prob > 0.0 or burst:
@@ -784,11 +823,32 @@ def advance_round(
             # src<dst dedup, silently shrinking the peer's degree
             self_draw = draws == jrows.astype(draws.dtype)[:, None]
             draws = jnp.where(state.exists[draws] & ~self_draw, draws, -1)
+            # membership-registry upkeep (growth/): degree_credit counts
+            # unfolded fresh IN-edges, so an overwrite of a rejoiner's
+            # stored targets must RELEASE the credit those edges granted
+            # (a previously grown/rewired peer's fresh edges vanish with
+            # the overwrite — without the release, phantom credit biases
+            # the preferential-attachment weights and the γ track, and
+            # breaks the fold invariant) and GRANT credit to the new
+            # draws. One (N, S)-index scatter pair, churn-join rounds
+            # with re-wiring only.
+            released = (fresh & rewired)[:, None] & (rewire_targets >= 0)
+            degree_credit = degree_credit.at[
+                jnp.where(released, rewire_targets, n).reshape(-1)
+            ].add(-1, mode="drop")
             if cap is None:
+                degree_credit = degree_credit.at[
+                    jnp.where(fresh[:, None] & (draws >= 0), draws, n)
+                    .reshape(-1)
+                ].add(1, mode="drop")
                 rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
                 rewired = rewired | fresh
             else:
                 sel_rows = jnp.where(jlive, jrows, n)  # n = dropped
+                degree_credit = degree_credit.at[
+                    jnp.where(jlive[:, None] & (draws >= 0), draws, n)
+                    .reshape(-1)
+                ].add(1, mode="drop")
                 rewire_targets = rewire_targets.at[sel_rows].set(
                     draws.astype(rewire_targets.dtype), mode="drop"
                 )
@@ -806,6 +866,44 @@ def advance_round(
                     unselected[:, None], -1, rewire_targets
                 )
 
+    # --- growth admission (row-level; growth/engine.py) -------------------
+    exists = state.exists
+    join_round = state.join_round
+    admitted_by = state.admitted_by
+    if growth is not None:
+        from tpu_gossip.growth.engine import apply_growth
+
+        if cfg.rewire_slots < growth.attach_m:
+            raise ValueError(
+                f"growth.attach_m={growth.attach_m} needs "
+                f"cfg.rewire_slots >= {growth.attach_m} — growth edges "
+                "ride the re-wiring plane's delivery paths"
+            )
+
+        jb = (
+            faults.join_burst
+            if faults is not None
+            else jnp.zeros((), dtype=jnp.int32)
+        )
+        grown = apply_growth(
+            growth, state.rng, rnd, jb,
+            row_ptr=state.row_ptr,
+            exists=exists, alive=alive, silent=silent, last_hb=last_hb,
+            declared_dead=declared_dead, rewired=rewired,
+            rewire_targets=rewire_targets, join_round=join_round,
+            admitted_by=admitted_by, degree_credit=degree_credit,
+        )
+        exists = grown["exists"]
+        alive = grown["alive"]
+        silent = grown["silent"]
+        last_hb = grown["last_hb"]
+        declared_dead = grown["declared_dead"]
+        rewired = grown["rewired"]
+        rewire_targets = grown["rewire_targets"]
+        join_round = grown["join_round"]
+        admitted_by = grown["admitted_by"]
+        degree_credit = grown["degree_credit"]
+
     # --- fused slot tail: dedup merge + latch + SIR + fresh resets --------
     seen, forwarded, infected_round, recovered = round_tail(
         state.seen, state.forwarded, state.infected_round, state.recovered,
@@ -822,7 +920,7 @@ def advance_round(
         forwarded=forwarded,
         infected_round=infected_round,
         recovered=recovered,
-        exists=state.exists,
+        exists=exists,
         alive=alive,
         silent=silent,
         last_hb=last_hb,
@@ -830,15 +928,18 @@ def advance_round(
         rewired=rewired,
         rewire_targets=rewire_targets,
         fault_held=state.fault_held if fault_held is None else fault_held,
+        join_round=join_round,
+        admitted_by=admitted_by,
+        degree_credit=degree_credit,
         rng=key,
         round=rnd,
     )
-    return new_state, _stats(new_state, msgs_sent, fstats)
+    return new_state, _stats(new_state, msgs_sent, fstats, growth)
 
 
 def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
-    scenario=None,
+    scenario=None, growth=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -852,6 +953,13 @@ def gossip_round(
     the fault stream derives separately (``fold_in(state.rng,
     FAULT_STREAM_SALT)``), so ``scenario=None`` — and any quiescent phase
     — reproduces the historical trajectory bit for bit.
+
+    ``growth`` (a :class:`~tpu_gossip.growth.CompiledGrowth`) admits
+    per-round join batches by preferential attachment (growth/): its
+    stream derives separately too (``GROWTH_STREAM_SALT``), so
+    ``growth=None`` and an exhausted schedule are likewise bit-identical
+    to the fixed-n round. Composes with ``scenario``: a ``join_burst``
+    phase adds admissions on top of the schedule's per-round rate.
     """
     validate_rewire_width(state, cfg)
     rnd = state.round + 1
@@ -864,7 +972,7 @@ def gossip_round(
         )
         return advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, tail=tail,
+            k_join, receptive, tail=tail, growth=growth,
         )
     from tpu_gossip.faults.inject import scenario_dissemination
 
@@ -880,7 +988,7 @@ def gossip_round(
     return advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, tail=tail, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem,
+        fault_held=held, fstats=telem, growth=growth,
     )
 
 
@@ -891,7 +999,7 @@ def gossip_round(
 )
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
-    tail: str = "fused", scenario=None,
+    tail: str = "fused", scenario=None, growth=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -903,12 +1011,14 @@ def simulate(
 
     ``scenario`` threads a compiled fault schedule (faults/) through the
     scan: the tables are loop-invariant operands, the round counter in the
-    carry is the scenario cursor.
+    carry is the scenario cursor. ``growth`` threads a compiled admission
+    schedule (growth/) the same way — the registry plane in the carry is
+    its cursor.
     """
 
     def body(carry, _):
         nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
-                                  scenario=scenario)
+                                  scenario=scenario, growth=growth)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -928,6 +1038,7 @@ def run_until_coverage(
     plan=None,
     tail: str = "fused",
     scenario=None,
+    growth=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -940,13 +1051,16 @@ def run_until_coverage(
 
     ``scenario`` injects a compiled fault schedule (faults/); rounds past
     its horizon run quiescent, so the loop can outlive the scenario.
+    ``growth`` admits per-round join batches (growth/); rounds past its
+    schedule run fixed-n.
     """
 
     def cond(s: SwarmState) -> jax.Array:
         return (s.coverage(slot) < target) & (s.round - state.round < max_rounds)
 
     def body(s: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario)
+        nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
+                              growth=growth)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)
