@@ -21,6 +21,7 @@ def test_audit_names_cover_declared_entry_points():
         "simulate_and_coverage",
         "pallas_wrappers",
         "gossip_round_dist",
+        "sparse_transport",
     }
 
 
@@ -83,6 +84,24 @@ def test_broken_growth_registry_detected(monkeypatch):
     findings = audit_contracts(names=["gossip_round_local"])
     assert findings, "audit missed a deliberate registry-plane break"
     assert all("growth" in f.message for f in findings)
+
+
+def test_broken_occupancy_header_detected(monkeypatch):
+    """Drift the occupancy header to float32: the sparse-transport check
+    must report it against the declared header_spec (both the runtime
+    gate and the analytic counter read this row — a silent drift would
+    desynchronize the lanes)."""
+    from tpu_gossip.dist import transport as tp
+
+    orig = tp.occupancy_counts
+
+    def broken(occ):
+        return orig(occ).astype("float32")
+
+    monkeypatch.setattr(tp, "occupancy_counts", broken)
+    findings = audit_contracts(names=["sparse_transport"])
+    assert findings, "audit missed a deliberate header dtype break"
+    assert any("occupancy header" in f.message for f in findings)
 
 
 def test_crashed_check_is_a_finding(monkeypatch):

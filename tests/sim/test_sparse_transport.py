@@ -1,0 +1,465 @@
+"""Sparsity-adaptive ICI transport (dist/transport.py): the compact lanes
+must be invisible to the protocol — bit-identical state AND stats across
+modes, scenarios, and growth on both shard engines — while the analytic
+counter proves bytes actually left the wire. Runs on the virtual 8-device
+CPU mesh (conftest forces XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip import SwarmConfig, build_csr, preferential_attachment
+from tpu_gossip.core.state import clone_state, init_swarm
+from tpu_gossip.dist import (
+    build_shard_plans,
+    build_transport,
+    init_sharded_swarm,
+    make_mesh,
+    partition_graph,
+    run_until_coverage_dist,
+    shard_matching_plan,
+    shard_swarm,
+    simulate_dist,
+)
+from tpu_gossip.dist.transport import (
+    IciRound,
+    accumulate_ici,
+    compact_index,
+    gather_compact,
+    occupancy_counts,
+    scatter_compact,
+    zero_ici_totals,
+)
+from tpu_gossip.sim.engine import simulate
+
+N = 997  # not divisible by 8: pad slots ride along
+
+
+@pytest.fixture(scope="module")
+def setup():
+    g = build_csr(N, preferential_attachment(N, m=3, use_native=False))
+    mesh = make_mesh(8)
+    sg, relabeled, position = partition_graph(g, 8, seed=1)
+    return mesh, sg, relabeled, position
+
+
+@pytest.fixture(scope="module")
+def matching_setup():
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    g, plan = matching_powerlaw_graph_sharded(
+        6000, 8, fanout=2, key=jax.random.key(0)
+    )
+    mesh = make_mesh(8)
+    plan_m = shard_matching_plan(plan, mesh)
+    return g, plan, plan_m, mesh, build_transport(plan_m, mode="sparse", mesh=mesh)
+
+
+def _assert_same_run(fin_a, stats_a, fin_b, stats_b):
+    """Full state + stats trajectory equality — the transport contract."""
+    for f in ("seen", "alive", "rewired", "declared_dead", "recovered",
+              "last_hb", "rewire_targets", "fault_held", "exists",
+              "join_round", "admitted_by", "degree_credit"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fin_a, f)), np.asarray(getattr(fin_b, f)),
+            err_msg=f,
+        )
+    for f in stats_a._fields:
+        if f == "degree_gamma":
+            # the one float reduction: documented to match across engines
+            # to 1 ULP (growth engine, PR 5), not bit-for-bit
+            np.testing.assert_allclose(
+                np.asarray(stats_a.degree_gamma),
+                np.asarray(stats_b.degree_gamma), rtol=5e-7, err_msg=f,
+            )
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(stats_a, f)), np.asarray(getattr(stats_b, f)),
+            err_msg=f,
+        )
+
+
+# ------------------------------------------------------------ unit pieces
+def test_compaction_round_trip_identity():
+    """gather -> send -> scatter is the identity on occupied words, zeros
+    elsewhere — the compact lane's whole correctness argument, at tiny n."""
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 5, size=(4, 24, 3)).astype(np.int32)
+    payload[rng.random((4, 24)) < 0.7] = 0  # sparse
+    occ = jax.numpy.asarray((payload != 0).any(-1))
+    cap = int(np.asarray(occ).sum(axis=1).max())
+    idx = compact_index(occ, cap)
+    vals = gather_compact(jax.numpy.asarray(payload), idx)
+    back = scatter_compact(idx, vals, 24)
+    np.testing.assert_array_equal(np.asarray(back), payload)
+    # header row: one count per destination, int32 — the declared spec
+    counts = occupancy_counts(occ)
+    assert counts.shape == (4,) and counts.dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(counts), (payload != 0).any(-1).sum(1)
+    )
+
+
+def test_compaction_overflow_goes_to_junk_column():
+    """Entries past the budget land in the discarded junk column, never in
+    a kept slot (the runtime gate prevents this case from shipping; the
+    index math must still be safe when probed directly)."""
+    occ = jax.numpy.asarray(np.ones((2, 10), dtype=bool))
+    idx = np.asarray(compact_index(occ, 4))
+    assert idx.shape == (2, 4)
+    np.testing.assert_array_equal(idx, [[0, 1, 2, 3], [0, 1, 2, 3]])
+
+
+@pytest.mark.parametrize("hubs", [0, 3], ids=["plain", "hub"])
+def test_sparse_transpose_round_trip(hubs):
+    """transpose_pass_sparse == transpose_pass_sharded on word-sparse data
+    (and the untranspose twin), under the real shard_map harness — with an
+    empty hub table (pure occupancy compaction) and with fully-dense hub
+    rows riding the static sub-lane. The budget covers the nonzero WORD
+    count, the engine gate's invariant (occupied rows per shard and per
+    destination range are both bounded by it)."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_gossip.dist._compat import shard_map_compat
+    from tpu_gossip.dist.transport import (
+        transpose_pass_sparse, untranspose_pass_sparse,
+    )
+    from tpu_gossip.kernels.permute import (
+        transpose_pass_sharded, untranspose_pass_sharded,
+    )
+
+    mesh = make_mesh(8)
+    s, per = 8, 128
+    r = s * per
+    rng = np.random.default_rng(7)
+    x = np.zeros((r, 128), dtype=np.int32)
+    # ~20 scattered nonzero leaf words — well under the budget
+    ii = rng.integers(0, r, 20)
+    jj = rng.integers(0, 128, 20)
+    x[ii, jj] = rng.integers(1, 1 << 20, 20)
+    hub_local = np.sort(rng.choice(per, size=hubs, replace=False)).astype(np.int32)
+    if hubs:
+        # the SAME local rows on every shard are fully dense (the sharded
+        # matching layout puts hub classes at identical block positions)
+        for sh in range(s):
+            x[sh * per + hub_local] = rng.integers(1, 1 << 20, (hubs, 128))
+    x = jax.numpy.asarray(x)
+    leaf_words = int(
+        np.asarray((np.asarray(x) != 0)).sum()
+    ) - hubs * s * 128
+    cap = leaf_words + 4
+    tbl_local = jax.numpy.asarray(np.broadcast_to(hub_local, (s, hubs)).copy())
+    empty = jax.numpy.zeros((s, 0), dtype=jax.numpy.int32)
+    # the untranspose's table space is OUTPUT slab rows: a dense input row
+    # smears across up to 128 slab rows (the reason deep stages go
+    # "plain"), so the hub case gives that pass the full per-dest budget —
+    # which always fits — while the t pass exercises the real hub sub-lane
+    cap_untr = per if hubs else cap
+    for k, (sparse_fn, dense_fn, tbl, c) in enumerate((
+        (transpose_pass_sparse, transpose_pass_sharded, tbl_local, cap),
+        (untranspose_pass_sparse, untranspose_pass_sharded, empty, cap_untr),
+    )):
+
+        @functools.partial(
+            shard_map_compat, mesh=mesh, in_specs=(P("peers"),),
+            out_specs=P("peers"), check_vma=False,
+        )
+        def go(blk, fn=sparse_fn, t=tbl, c=c):
+            return fn(blk, "peers", s, t, c)
+
+        @functools.partial(
+            shard_map_compat, mesh=mesh, in_specs=(P("peers"),),
+            out_specs=P("peers"), check_vma=False,
+        )
+        def go_dense(blk, fn=dense_fn):
+            return fn(blk, "peers", s)
+
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(go)(x)), np.asarray(jax.jit(go_dense)(x)),
+            err_msg=f"pass {k}",
+        )
+
+
+def test_transport_rejects_mismatched_layout(setup, matching_setup):
+    from tpu_gossip.dist.mesh import gossip_round_dist
+
+    mesh, sg, relabeled, position = setup
+    _, plan, plan_m, _, tr_match = matching_setup
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=4, fanout=2, mode="push")
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0]), mesh
+    )
+    with pytest.raises(ValueError, match="matching family"):
+        gossip_round_dist(st, cfg, sg, mesh, transport=tr_match)
+    # and a transport from a different partition of the same sizes
+    sg2, _, _ = partition_graph(
+        build_csr(N, preferential_attachment(N, m=3, use_native=False)), 8,
+        seed=99,
+    )
+    tr2 = build_transport(sg2, mode="sparse")
+    with pytest.raises(ValueError, match="fingerprint"):
+        gossip_round_dist(st, cfg, sg, mesh, transport=tr2)
+
+
+# ------------------------------------------------- bucketed engine parity
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("flood", {}),
+        ("push", {}),
+        ("push_pull", {}),
+        ("push_pull", dict(forward_once=True)),
+        ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
+                           rewire_slots=2)),
+    ],
+    ids=["flood", "push", "push_pull", "push_pull_fwd_once",
+         "push_pull_churn"],
+)
+def test_bucketed_sparse_bit_identical(setup, mode, extra):
+    """Sparse vs dense transport on the bucketed engine: compaction
+    reorders bytes, not draws — the full state + stats trajectory must be
+    bit-identical in every mode, churn re-wiring included."""
+    mesh, sg, relabeled, position = setup
+    tr = build_transport(sg, mode="sparse")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2, mode=mode,
+                      **extra)
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0, 1],
+                           key=jax.random.key(3)), mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 6)
+    fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 6, None, None, None, tr)
+    _assert_same_run(fin_a, stats_a, fin_b, stats_b)
+
+
+def test_bucketed_sparse_kernel_receive_bit_identical(setup):
+    """The staircase-kernel receive streams the RECONSTRUCTED dense buffer
+    — compact lane + kernel receive must still match the dense scatter."""
+    mesh, sg, relabeled, position = setup
+    plans = build_shard_plans(sg)
+    tr = build_transport(sg, mode="sparse")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0, 1],
+                           key=jax.random.key(3)), mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 6)
+    fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 6, plans, None, None, tr)
+    _assert_same_run(fin_a, stats_a, fin_b, stats_b)
+
+
+def test_bucketed_sparse_scenario_bit_identical(setup):
+    """Every fault class active (loss + delay + partition + blackout +
+    churn burst): the fault head wraps the dissemination core ABOVE the
+    lane choice, so the trajectories must stay bit-identical."""
+    from tests.sim.test_dist import _chaos_spec
+    from tpu_gossip.faults import compile_scenario
+
+    mesh, sg, relabeled, position = setup
+    tr = build_transport(sg, mode="sparse")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    sc = compile_scenario(
+        _chaos_spec(), n_peers=N, n_slots=sg.n_pad, total_rounds=8,
+        node_map=lambda ids: position[np.asarray(ids)],
+    )
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0, 1],
+                           key=jax.random.key(3)), mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 6, None, sc)
+    fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 6, None, sc, None, tr)
+    _assert_same_run(fin_a, stats_a, fin_b, stats_b)
+    assert np.asarray(stats_b.msgs_dropped).sum() > 0  # the chaos must bite
+
+
+def test_bucketed_gate_falls_back_when_dense(setup):
+    """A mid-epidemic state whose occupancy exceeds the budget must ride
+    the dense lane at runtime (sparse_lanes == 0) and still be identical."""
+    mesh, sg, relabeled, position = setup
+    tr = build_transport(sg, mode="sparse")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, mode="flood")
+    st0 = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
+    # everyone transmits: every valid bucket entry is occupied
+    st0 = dataclasses.replace(st0, seen=st0.seen.at[:, 0].set(st0.exists))
+    st = shard_swarm(st0, mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 2)
+    fin_b, (stats_b, ici) = simulate_dist(
+        st, cfg, sg, mesh, 2, None, None, None, tr, True
+    )
+    _assert_same_run(fin_a, stats_a, fin_b, stats_b)
+    assert int(np.asarray(ici.sparse_lanes)[0]) == 0
+    assert int(np.asarray(ici.shipped_words)[0]) > int(
+        np.asarray(ici.dense_words)[0]
+    )  # dense + header: the fallback is priced honestly
+
+
+# ------------------------------------------------- matching engine parity
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("flood", {}),
+        ("push", {}),
+        ("push_pull", {}),
+        ("push_pull", dict(forward_once=True)),
+        ("push_pull", dict(sir_recover_rounds=2)),
+        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                           rewire_slots=2)),
+    ],
+    ids=["flood", "push", "push_pull", "push_pull_fwd_once", "push_pull_sir",
+         "push_pull_churn"],
+)
+def test_matching_sparse_bit_identical_to_local(matching_setup, mode, extra):
+    """THE acceptance criterion, matching family: a sparse mesh round must
+    be bit-identical to the LOCAL engine's round — the strongest statement
+    available, since the dense mesh round already is."""
+    g, plan, plan_m, mesh, tr = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode=mode,
+                      **extra)
+    st = init_swarm(g.as_padded_graph(), cfg, origins=[0, 5],
+                    exists=g.exists, key=jax.random.key(3))
+    fin_l, stats_l = simulate(clone_state(st), cfg, 5, plan)
+    fin_d, (stats_d, ici) = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 5, None, None, None, tr,
+        True,
+    )
+    _assert_same_run(fin_l, stats_l, fin_d, stats_d)
+    # the sparse lane must actually run in the early phase, or the parity
+    # above is vacuous
+    assert int(np.asarray(ici.sparse_lanes)[0]) > 0
+
+
+def test_matching_sparse_scenario_bit_identical(matching_setup):
+    """Every fault class + sparse transport vs the local engine."""
+    from tests.sim.test_dist import _chaos_spec
+    from tpu_gossip.faults import compile_scenario
+
+    g, plan, plan_m, mesh, tr = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    st = init_swarm(g.as_padded_graph(), cfg, origins=[0, 5],
+                    exists=g.exists, key=jax.random.key(3))
+
+    def rows_of(ids):
+        ids = np.asarray(ids)
+        return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+    sc = compile_scenario(
+        _chaos_spec(), n_peers=6000, n_slots=plan.n, total_rounds=8,
+        node_map=rows_of,
+    )
+    fin_l, stats_l = simulate(clone_state(st), cfg, 6, plan, "fused", sc)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 6, None, sc, None, tr
+    )
+    _assert_same_run(fin_l, stats_l, fin_d, stats_d)
+    assert np.asarray(stats_d.msgs_dropped).sum() > 0
+
+
+def test_matching_sparse_growing_bit_identical():
+    """A GROWING sparse mesh run (the tests/sim/test_dist.py PR 4/5
+    pattern): admissions ride advance_round outside the transport, so the
+    membership extension of the parity contract holds under compaction."""
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.growth import compile_growth, matching_admit_rows
+
+    mesh = make_mesh(8)
+    g, plan = matching_powerlaw_graph_sharded(
+        4000, 8, fanout=2, key=jax.random.key(0), growth_rows=16
+    )
+    plan_m = shard_matching_plan(plan, mesh)
+    tr = build_transport(plan_m, mode="sparse", mesh=mesh)
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2,
+                      mode="push_pull", rewire_slots=2)
+    grow = compile_growth(
+        n_initial=4000, target=4100, n_slots=plan.n, joins_per_round=16,
+        attach_m=2, admit_rows=matching_admit_rows(plan, 100),
+    )
+    st = init_swarm(g.as_padded_graph(), cfg, origins=[0, 5],
+                    exists=g.exists, key=jax.random.key(3))
+    fin_l, stats_l = simulate(clone_state(st), cfg, 8, plan, growth=grow)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 8, None, None, grow, tr
+    )
+    _assert_same_run(fin_l, stats_l, fin_d, stats_d)
+    assert int(np.asarray(stats_d.n_members)[-1]) > 4000
+
+
+# --------------------------------------------------------- ici accounting
+def test_ici_counter_early_phase_reduction(matching_setup):
+    """The analytic counter: early-phase shipped bytes must undercut dense
+    by >= 3x (the ROADMAP success metric, tracked from this PR on), and
+    the trajectory must go dense mid-epidemic."""
+    g, plan, plan_m, mesh, tr = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode="push_pull")
+    st = init_swarm(g.as_padded_graph(), cfg, origins=[0],
+                    exists=g.exists, key=jax.random.key(3))
+    _, (stats, ici) = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 10, None, None, None, tr,
+        True,
+    )
+    dense = np.asarray(ici.dense_words).astype(np.int64)
+    shipped = np.asarray(ici.shipped_words).astype(np.int64)
+    assert dense[0] >= 3 * shipped[0], (dense[0], shipped[0])
+    assert (shipped <= dense + np.asarray(ici.total_lanes) * 16 * 3).all()
+    # mid-epidemic rounds fall back to dense (plus the tiny header)
+    assert (np.asarray(ici.sparse_lanes) < np.asarray(ici.total_lanes)).any()
+
+
+def test_ici_coverage_totals_accumulate(setup):
+    mesh, sg, relabeled, position = setup
+    tr = build_transport(sg, mode="sparse")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2, mode="push")
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0]), mesh
+    )
+    fin, tot = run_until_coverage_dist(
+        st, cfg, sg, mesh, 0.99, 100, transport=tr, collect_ici=True
+    )
+    rounds = int(fin.round)
+    words = tot.words()
+    assert rounds > 0
+    assert words["total_lanes"] == rounds  # one gated exchange per push round
+    assert words["shipped_words"] < words["dense_words"]
+
+
+def test_ici_totals_accumulator_exact_past_int32():
+    """The while-carry totals ride a hi/lo int32 pair: folding in 100
+    rounds of 3e7 dense words each must read back the exact 3e9 total —
+    a plain int32 sum wraps negative at this (1M-matching-realistic)
+    scale."""
+    import jax.numpy as jnp
+
+    one = IciRound(
+        jnp.int32(30_000_000), jnp.int32(7_654_321), jnp.int32(123_456),
+        jnp.int32(5), jnp.int32(6),
+    )
+    tot = zero_ici_totals()
+    step = jax.jit(accumulate_ici)
+    for _ in range(100):
+        tot = step(tot, one)
+    words = tot.words()
+    assert words["dense_words"] == 3_000_000_000
+    assert words["shipped_words"] == 765_432_100
+    assert words["occupied_words"] == 12_345_600
+    assert words["sparse_lanes"] == 500
+    assert words["total_lanes"] == 600
+
+
+def test_auto_mode_is_bit_identical_too(setup):
+    mesh, sg, relabeled, position = setup
+    tr = build_transport(sg, mode="auto")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    st = shard_swarm(
+        init_sharded_swarm(sg, relabeled, position, cfg, origins=[0],
+                           key=jax.random.key(1)), mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 4)
+    fin_b, stats_b = simulate_dist(st, cfg, sg, mesh, 4, None, None, None, tr)
+    _assert_same_run(fin_a, stats_a, fin_b, stats_b)

@@ -694,6 +694,155 @@ def _check_dist() -> list:
     return problems
 
 
+@audit_check("sparse_transport")
+def _check_sparse_transport() -> list:
+    """The sparsity-adaptive transport's declared contracts
+    (dist/transport.py): the occupancy header's dtype/shape, the Transport
+    tables' specs, and both dist engines under ``transport=sparse``
+    staying a state fixed point with IciRound declared as scalar int32 —
+    the abstract half of the transport's bit-identity contract (the
+    concrete half lives in tests/sim/test_sparse_transport.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_gossip import dist as dist_pkg
+    from tpu_gossip.core import matching_topology as mt
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.dist import mesh as mesh_mod
+    from tpu_gossip.dist import transport as tp
+
+    problems: list[str] = []
+    mesh = dist_pkg.make_mesh()
+    if 128 % mesh.size:
+        return [
+            f"mesh size {mesh.size} does not divide 128 — sparse transport "
+            "contract unverifiable on this host (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        ]
+    # the occupancy header: one shard's per-destination counts must carry
+    # the DECLARED spec (header_spec) — the receiver gate and the analytic
+    # counter both read it, so a silent dtype/shape drift desynchronizes
+    # the lanes. Resolved through the module so a deliberate break is
+    # detected (tests/analysis/test_contracts.py).
+    occ = jax.ShapeDtypeStruct((mesh.size, 64), jnp.bool_)
+    try:
+        hdr = jax.eval_shape(tp.occupancy_counts, occ)
+        want = tp.header_spec(mesh.size)
+        if (tuple(hdr.shape), hdr.dtype) != (tuple(want.shape), want.dtype):
+            problems.append(
+                f"occupancy header: {tuple(hdr.shape)}/{hdr.dtype} != "
+                f"declared {tuple(want.shape)}/{want.dtype}"
+            )
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"occupancy_counts: abstract eval failed: {e!r:.200}")
+
+    def ici_contract(name, ici):
+        for field in tp.IciRound._fields:
+            leaf = getattr(ici, field, None)
+            if leaf is None:
+                problems.append(f"{name}: IciRound lost field {field!r}")
+            elif tuple(leaf.shape) != () or leaf.dtype != jnp.int32:
+                problems.append(
+                    f"{name}: IciRound.{field} {tuple(leaf.shape)}/"
+                    f"{leaf.dtype} != declared scalar int32"
+                )
+
+    # matching engine: transport tables + sparse round fixed point
+    g, plan = mt.matching_powerlaw_graph_sharded(
+        _N_MATCH, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    tr = tp.build_transport(plan, mode="sparse")
+    if tr.leaf_slots is None or (
+        tuple(tr.leaf_slots.shape), str(tr.leaf_slots.dtype)
+    ) != ((plan.rows, 128), "bool"):
+        problems.append(
+            "matching transport: leaf_slots missing or != declared "
+            f"({plan.rows}, 128)/bool"
+        )
+    n_transposes = sum(1 for st in plan.stages if st[0] in ("t", "tinv"))
+    if len(tr.hub_tables) != n_transposes or len(tr.stage_mode) != n_transposes:
+        problems.append(
+            f"matching transport: {len(tr.hub_tables)} hub tables / "
+            f"{len(tr.stage_mode)} stage modes for {n_transposes} "
+            "transpose stages"
+        )
+    for k, tbl in enumerate(tr.hub_tables):
+        if tbl.ndim != 2 or tbl.shape[0] != mesh.size or str(tbl.dtype) != "int32":
+            problems.append(
+                f"matching transport: hub_tables[{k}] "
+                f"{tuple(tbl.shape)}/{tbl.dtype} != declared "
+                f"({mesh.size}, H)/int32"
+            )
+    if not (0 < tr.budget <= plan.per_rows):
+        problems.append(
+            f"matching transport: budget {tr.budget} outside (0, per_rows]"
+        )
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull")
+    st = init_swarm(
+        g.as_padded_graph(), cfg, origins=[0], exists=g.exists,
+        key=jax.random.key(0),
+    )
+    try:
+        out_st, out_stats, ici = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(
+                s, cfg, plan, mesh, transport=tr, collect_ici=True
+            ),
+            st,
+        )
+        _diff_specs(
+            "gossip_round_dist[matching,sparse]",
+            _spec_tree(out_st), _spec_tree(st), problems,
+        )
+        _stats_contract(out_stats, problems)
+        ici_contract("gossip_round_dist[matching,sparse]", ici)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[matching,sparse]: abstract eval failed: "
+            f"{e!r:.200}"
+        )
+    # bucketed engine under transport=sparse
+    from tpu_gossip.core.topology import (
+        build_csr, configuration_model, powerlaw_degree_sequence,
+    )
+
+    rng = np.random.default_rng(0)
+    graph = build_csr(
+        _N_DEV,
+        configuration_model(
+            powerlaw_degree_sequence(_N_DEV, gamma=2.5, rng=rng), rng=rng
+        ),
+    )
+    sg, relabeled, position = mesh_mod.partition_graph(graph, mesh.size, seed=0)
+    tr_b = tp.build_transport(sg, mode="sparse")
+    if not (0 < tr_b.budget <= sg.bucket):
+        problems.append(
+            f"bucketed transport: budget {tr_b.budget} outside (0, bucket]"
+        )
+    cfg2 = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
+    st2 = mesh_mod.init_sharded_swarm(sg, relabeled, position, cfg2, origins=[0])
+    try:
+        out_st, out_stats, ici = jax.eval_shape(
+            lambda s: mesh_mod.gossip_round_dist(
+                s, cfg2, sg, mesh, transport=tr_b, collect_ici=True
+            ),
+            st2,
+        )
+        _diff_specs(
+            "gossip_round_dist[bucketed,sparse]",
+            _spec_tree(out_st), _spec_tree(st2), problems,
+        )
+        _stats_contract(out_stats, problems)
+        ici_contract("gossip_round_dist[bucketed,sparse]", ici)
+    except Exception as e:  # noqa: BLE001
+        problems.append(
+            f"gossip_round_dist[bucketed,sparse]: abstract eval failed: "
+            f"{e!r:.200}"
+        )
+    return problems
+
+
 def audit_contracts(names=None) -> list[Finding]:
     """Run the contract checks; each problem line becomes one Finding."""
     findings: list[Finding] = []

@@ -93,6 +93,19 @@ def build_parser() -> argparse.ArgumentParser:
         "the per-shard staircase kernel (the north-star fusion)",
     )
     p.add_argument(
+        "--transport", choices=["dense", "sparse", "auto"], default="dense",
+        help="sharded-exchange transport (dist/transport.py, docs/"
+        "sparse_exchange.md): dense ships the full rectangular all_to_all "
+        "payloads every round; sparse compacts occupied words into a "
+        "static worst-case buffer behind a per-round occupancy header "
+        "(hub rows ride a dense sub-lane on the matching family), falling "
+        "back to the dense lane whenever the round's occupancy exceeds "
+        "the budget; auto additionally requires the static geometry to "
+        "predict a byte win. Bit-identical to dense in every mode — the "
+        "transport reorders bytes, never draws. Requires --shard; the "
+        "summary JSON gains transport + realized occupancy/bytes fields",
+    )
+    p.add_argument(
         "--tail", choices=["fused", "reference", "pallas"], default="fused",
         help="protocol-tail implementation (kernels/round_tail.py): fused "
         "(single lax traversal, the default), reference (the historical "
@@ -213,6 +226,14 @@ def main(argv: list[str] | None = None) -> int:
         print("--profile-round decomposes the LOCAL round (use "
               "experiments/dist_profile.py for the mesh engines)",
               file=sys.stderr)
+        return 2
+    if args.transport != "dense" and not args.shard:
+        # parse-time rejection, like --scenario path errors: the transport
+        # compacts the SHARDED exchanges — a local run has no collective
+        # to compact, and silently ignoring the flag would fake the A/B
+        print(f"--transport {args.transport} compacts the sharded "
+              "exchanges (dist/transport.py); add --shard (the local "
+              "engine moves no ICI bytes)", file=sys.stderr)
         return 2
     if args.tail != "fused" and args.shard:
         # the dist engines run advance_round's default tail; a summary that
@@ -468,6 +489,35 @@ def _compile_cli_scenario(
     )
 
 
+def _transport_summary(args, ici=None, rounds=0) -> dict:
+    """Summary-row transport fields for a --shard run: the configured lane
+    plus, when the analytic counter ran, realized occupancy/bytes —
+    dense vs shipped vs occupied, bytes/round (dist/transport.IciRound;
+    word counters summed in int64 host-side so long runs can't wrap)."""
+    if not args.shard:
+        return {}
+    out = {"transport": args.transport}
+    if ici is None:
+        return out
+    tot = {
+        f: int(np.asarray(getattr(ici, f)).astype(np.int64).sum())
+        for f in ici._fields
+    }
+    r = max(rounds, 1)
+    out["ici_bytes_per_round"] = {
+        "dense": round(4 * tot["dense_words"] / r, 1),
+        "shipped": round(4 * tot["shipped_words"] / r, 1),
+        "occupied": round(4 * tot["occupied_words"] / r, 1),
+        "reduction_vs_dense": round(
+            tot["dense_words"] / max(tot["shipped_words"], 1), 3
+        ),
+    }
+    out["sparse_lanes"] = {
+        "taken": tot["sparse_lanes"], "gated": tot["total_lanes"],
+    }
+    return out
+
+
 def _scenario_summary(spec, stats=None) -> dict:
     """Summary-row fields for an active scenario (+ per-phase report when
     per-round stats exist)."""
@@ -671,8 +721,8 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
     import jax
 
     from tpu_gossip.dist import (
-        build_shard_plans, repartition_swarm, run_until_coverage_dist,
-        shard_swarm, simulate_dist,
+        build_shard_plans, build_transport, repartition_swarm,
+        run_until_coverage_dist, shard_swarm, simulate_dist,
     )
     from tpu_gossip.sim import metrics as M
     from tpu_gossip.sim.engine import remat_capacity, rematerialize_rewired
@@ -684,6 +734,15 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
     rebuild_s = 0.0
     stats_parts = []
 
+    def transport_for(sg_now):
+        # the compact lane's tables key on the bucket layout, so each
+        # epoch re-partition rebuilds them (host-side, like the plans)
+        if args.transport == "dense":
+            return None
+        return build_transport(sg_now, mode=args.transport)
+
+    transport = transport_for(sg)
+
     # warm the first segment outside the timed region (same static shapes)
     # on a throwaway clone — the dist engines donate their state
     from tpu_gossip.core.state import clone_state
@@ -691,11 +750,11 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
     seg0 = min(r, total)
     if args.rounds > 0:
         warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans,
-                             scen)[0]
+                             scen, None, transport)[0]
     else:
         warm = run_until_coverage_dist(
             clone_state(state), cfg, sg, mesh, args.target, seg0,
-            shard_plan=plans, scenario=scen,
+            shard_plan=plans, scenario=scen, transport=transport,
         )
     float(warm.coverage(0))
     del warm
@@ -705,12 +764,12 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
         seg = min(r, total - int(state.round))
         if args.rounds > 0:
             state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans,
-                                         scen)
+                                         scen, None, transport)
             stats_parts.append(stats)
         else:
             state = run_until_coverage_dist(
                 state, cfg, sg, mesh, args.target, seg, shard_plan=plans,
-                scenario=scen,
+                scenario=scen, transport=transport,
             )
             if float(state.coverage(0)) >= args.target:
                 break
@@ -724,6 +783,7 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
             state = shard_swarm(state, mesh)
             if plans is not None:
                 plans = build_shard_plans(sg)
+            transport = transport_for(sg)
             rebuild_s += _time.perf_counter() - tr
             remats += 1
             overflow_total += int(overflow)
@@ -826,6 +886,12 @@ def _main_shard_matching(args, rng, spec=None) -> int:
         ),
     )
     plan = shard_matching_plan(plan, mesh)
+    from tpu_gossip.dist import build_transport
+
+    transport = (
+        build_transport(plan, mode=args.transport, mesh=mesh)
+        if args.transport != "dense" else None
+    )
     cfg = SwarmConfig(
         n_peers=plan.n,  # per-shard blocks incl. born-dead pad rows
         msg_slots=args.slots,
@@ -862,23 +928,52 @@ def _main_shard_matching(args, rng, spec=None) -> int:
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
     with trace(args.profile):
         if args.rounds > 0:
-            fin, stats = simulate_dist(state, cfg, plan, mesh, args.rounds,
-                                       None, scen, grow)
+            if transport is not None:
+                fin, (stats, ici) = simulate_dist(
+                    state, cfg, plan, mesh, args.rounds, None, scen, grow,
+                    transport, True,
+                )
+            else:
+                fin, stats = simulate_dist(state, cfg, plan, mesh,
+                                           args.rounds, None, scen, grow)
+                ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            summary = _horizon_summary(args, stats, devices=mesh.size,
-                                       **_scenario_summary(spec, stats))
+            summary = _horizon_summary(
+                args, stats, devices=mesh.size,
+                **_scenario_summary(spec, stats),
+                **_transport_summary(args, ici, args.rounds),
+            )
         else:
+            # the timed region runs WITHOUT the analytic counter so the
+            # sparse-vs-dense ms_per_round A/B measures pure transport;
+            # the trajectory comes from an untimed bit-identical replay
+            # at the realized horizon (the bench.py pattern), summed in
+            # int64 host-side
+            def cov_run(st):
+                return run_until_coverage_dist(
+                    st, cfg, plan, mesh, args.target, args.max_rounds,
+                    scenario=scen, growth=grow, transport=transport,
+                )
+
+            r0 = int(state.round)
             result, fin = M.bench_swarm(
                 state, cfg, args.target, args.max_rounds, n_peers=args.peers,
-                run=lambda st: run_until_coverage_dist(
-                    st, cfg, plan, mesh, args.target, args.max_rounds,
-                    scenario=scen, growth=grow,
-                ),
+                run=cov_run,
             )
+            rounds = int(fin.round) - r0
+            ici = None
+            if transport is not None and rounds > 0:
+                from tpu_gossip.core.state import clone_state
+
+                _, (_stats, ici) = simulate_dist(
+                    clone_state(state), cfg, plan, mesh, rounds, None, scen,
+                    grow, transport, True,
+                )
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
                        **_scenario_summary(spec),
+                       **_transport_summary(args, ici, rounds),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
@@ -896,6 +991,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
     from tpu_gossip.core.state import SwarmConfig, save_swarm
     from tpu_gossip.dist import (
         build_shard_plans,
+        build_transport,
         init_sharded_swarm,
         make_mesh,
         partition_graph,
@@ -913,6 +1009,10 @@ def _main_shard(args, graph, rng, spec=None) -> int:
 
         graph, gexists = pad_graph_for_growth(graph, args.grow_capacity)
     sg, relabeled, position = partition_graph(graph, mesh.size, seed=args.seed)
+    transport = (
+        build_transport(sg, mode=args.transport)
+        if args.transport != "dense" else None
+    )
     cfg = SwarmConfig(
         n_peers=sg.n_pad,  # padded slot space; pads are born dead
         msg_slots=args.slots,
@@ -952,26 +1052,55 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 args, cfg, state, sg, mesh, plans, scen
             )
             summary.update(_scenario_summary(spec))
+            summary.update(_transport_summary(args))
         elif args.rounds > 0:
-            fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
-                                       plans, scen, grow)
+            if transport is not None:
+                fin, (stats, ici) = simulate_dist(
+                    state, cfg, sg, mesh, args.rounds, plans, scen, grow,
+                    transport, True,
+                )
+            else:
+                fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
+                                           plans, scen, grow)
+                ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            summary = _horizon_summary(args, stats, devices=mesh.size,
-                                       **_scenario_summary(spec, stats))
+            summary = _horizon_summary(
+                args, stats, devices=mesh.size,
+                **_scenario_summary(spec, stats),
+                **_transport_summary(args, ici, args.rounds),
+            )
         else:
             # the shared timing harness (warmup, fetch barrier) with the
             # dist engine's while_loop swapped in; report the real peer
-            # count, not the padded slot count
-            result, fin = M.bench_swarm(
-                state, cfg, args.target, args.max_rounds, n_peers=args.peers,
-                run=lambda st: run_until_coverage_dist(
+            # count, not the padded slot count. The timed region runs
+            # WITHOUT the analytic counter (pure-transport A/B); the
+            # trajectory comes from an untimed bit-identical replay at
+            # the realized horizon, summed in int64 host-side
+            def cov_run(st):
+                return run_until_coverage_dist(
                     st, cfg, sg, mesh, args.target, args.max_rounds,
                     shard_plan=plans, scenario=scen, growth=grow,
-                ),
+                    transport=transport,
+                )
+
+            r0 = int(state.round)
+            result, fin = M.bench_swarm(
+                state, cfg, args.target, args.max_rounds, n_peers=args.peers,
+                run=cov_run,
             )
+            rounds = int(fin.round) - r0
+            ici = None
+            if transport is not None and rounds > 0:
+                from tpu_gossip.core.state import clone_state
+
+                _, (_stats, ici) = simulate_dist(
+                    clone_state(state), cfg, sg, mesh, rounds, plans, scen,
+                    grow, transport, True,
+                )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
                        **_scenario_summary(spec),
+                       **_transport_summary(args, ici, rounds),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))

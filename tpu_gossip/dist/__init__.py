@@ -9,6 +9,7 @@ fan-out is one ``all_to_all`` inside ``shard_map``.
 
 from tpu_gossip.dist._compat import shard_map_compat
 from tpu_gossip.dist.matching_mesh import shard_matching_plan
+from tpu_gossip.dist.transport import IciRound, Transport, build_transport
 from tpu_gossip.dist.mesh import (
     ShardedGraph,
     ShardPlans,
@@ -24,8 +25,11 @@ from tpu_gossip.dist.mesh import (
 )
 
 __all__ = [
+    "IciRound",
     "ShardedGraph",
     "ShardPlans",
+    "Transport",
+    "build_transport",
     "make_mesh",
     "partition_graph",
     "build_shard_plans",

@@ -119,6 +119,7 @@ def _matching_exchange_dist(
     do_push: bool = True,
     do_pull: bool = False,
     interpret: bool | None = None,
+    transport=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Sampled matching delivery on the mesh — the contract (and the bits)
     of ``kernels.matching.matching_sampled``.
@@ -129,9 +130,19 @@ def _matching_exchange_dist(
     the local engine). Expand, the pipeline (lane shuffles + all_to_all
     transposes), pull gates (they need the shard-local expand of
     ``deg_real``), reduce, and billing run per shard inside.
+
+    ``transport`` (dist/transport.py) lane-gates every transpose pass:
+    hub rows (static tables) ride dense, occupied leaf rows compact, one
+    ``psum``'d leaf-word count per pipeline application is the occupancy
+    header — the count is conserved by the permutation, so it bounds
+    every stage. No draw is touched: sparse rounds stay bit-identical.
     """
     if plan.fanout is None or plan.deg_other is None:
         raise ValueError("plan built without fanout — no sampling gates")
+    if transport is not None:
+        transport.check_matches_plan(plan)
+        if not transport.active:
+            transport = None
     s = plan.mesh_shards
     groups = _slot_groups(m)
     shape = (plan.rows, 128)
@@ -173,11 +184,18 @@ def _matching_exchange_dist(
             operands.append(receptive_rows)
     operands += list(plan.lanes) + [plan.m3] + list(plan.lanes_inv)
     k_stages = len(plan.lanes)
+    in_specs = [P(AXIS)] * len(operands)
+    if transport is not None:
+        operands.append(transport.leaf_slots)
+        in_specs.append(P(AXIS))
+        operands += list(transport.hub_tables)
+        # hub tables are tiny and read by sender AND receiver: replicated
+        in_specs += [P()] * len(transport.hub_tables)
 
     @functools.partial(
         shard_map_compat,
         mesh=mesh,
-        in_specs=(P(AXIS),) * len(operands),
+        in_specs=tuple(in_specs),
         out_specs=(P(AXIS), P(AXIS)),
         # lane shuffles and the fold kernel launch pallas_call with
         # shard-varying tables, which the replication checker cannot type
@@ -185,6 +203,8 @@ def _matching_exchange_dist(
         check_vma=False,
     )
     def ex(*blks):
+        from tpu_gossip.dist.transport import apply_pipeline_transport
+
         it = iter(blks)
         txw = next(it)  # (n_blk, G)
         answ = next(it) if ans_words is not None else None
@@ -195,11 +215,33 @@ def _matching_exchange_dist(
         lane_blks = [next(it) for _ in range(k_stages)]
         m3_blk = next(it)
         lanes_inv_blks = [next(it) for _ in range(k_stages)]
+        if transport is not None:
+            leaf_blk = next(it)  # (per_rows, 128) bool
+            hub_blks = [next(it) for _ in range(len(transport.hub_tables))]
         stages = _local_stages(lane_blks, m3_blk, lanes_inv_blks)
 
         def partner(x):
-            return apply_pipeline(
-                x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+            if transport is None:
+                return apply_pipeline(
+                    x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+                )
+            # occupancy header: the plane's (total, leaf-origin) nonzero
+            # word counts, psum'd — both conserved by the permutation, so
+            # two replicated gates bound every stage's compact occupancy
+            # ("hub" stages gate on leaf words, "plain" stages on all)
+            nz = x != 0
+            cnts = jax.lax.psum(
+                jnp.stack([
+                    jnp.sum(nz, dtype=jnp.int32),
+                    jnp.sum(nz & leaf_blk, dtype=jnp.int32),
+                ]),
+                AXIS,
+            )
+            return apply_pipeline_transport(
+                x, stages, hub_blks, transport.stage_mode,
+                transport.budget, cnts[1] <= transport.budget,
+                cnts[0] <= transport.budget,
+                axis_name=AXIS, n_shards=s, interpret=interpret,
             )
 
         msgs = jnp.zeros((), jnp.int32)
@@ -273,10 +315,16 @@ def _matching_flood_dist(
     m: int,
     *,
     interpret: bool | None = None,
+    transport=None,
 ) -> jax.Array:
     """Flood delivery on the mesh — ``kernels.matching.matching_flood``
     per shard (deterministic: no gates, no billing — the engine bills
-    flood off CSR degrees)."""
+    flood off CSR degrees). ``transport`` lane-gates the transposes like
+    the sampled path (same header, same tables)."""
+    if transport is not None:
+        transport.check_matches_plan(plan)
+        if not transport.active:
+            transport = None
     s = plan.mesh_shards
     groups = _slot_groups(m)
     tx_words = jnp.stack(
@@ -291,26 +339,57 @@ def _matching_flood_dist(
         [tx_words, plan.valid] + list(plan.lanes) + [plan.m3]
         + list(plan.lanes_inv)
     )
+    in_specs = [P(AXIS)] * len(operands)
+    if transport is not None:
+        operands.append(transport.leaf_slots)
+        in_specs.append(P(AXIS))
+        operands += list(transport.hub_tables)
+        in_specs += [P()] * len(transport.hub_tables)
 
     @functools.partial(
         shard_map_compat,
         mesh=mesh,
-        in_specs=(P(AXIS),) * len(operands),
+        in_specs=tuple(in_specs),
         out_specs=P(AXIS),
         check_vma=False,
     )
     def ex(*blks):
+        from tpu_gossip.dist.transport import apply_pipeline_transport
+
         it = iter(blks)
         txw, valid_blk = next(it), next(it)
         lane_blks = [next(it) for _ in range(k_stages)]
         m3_blk = next(it)
         lanes_inv_blks = [next(it) for _ in range(k_stages)]
+        if transport is not None:
+            leaf_blk = next(it)
+            hub_blks = [next(it) for _ in range(len(transport.hub_tables))]
         stages = _local_stages(lane_blks, m3_blk, lanes_inv_blks)
+
+        def partner(x):
+            if transport is None:
+                return apply_pipeline(
+                    x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+                )
+            nz = x != 0
+            cnts = jax.lax.psum(
+                jnp.stack([
+                    jnp.sum(nz, dtype=jnp.int32),
+                    jnp.sum(nz & leaf_blk, dtype=jnp.int32),
+                ]),
+                AXIS,
+            )
+            return apply_pipeline_transport(
+                x, stages, hub_blks, transport.stage_mode,
+                transport.budget, cnts[1] <= transport.budget,
+                cnts[0] <= transport.budget,
+                axis_name=AXIS, n_shards=s, interpret=interpret,
+            )
+
         outs = []
         for gi, (_, w) in enumerate(groups):
-            across = apply_pipeline(
-                expand_classes(txw[:, gi], local_classes, per_rows),
-                stages, interpret=interpret, axis_name=AXIS, n_shards=s,
+            across = partner(
+                expand_classes(txw[:, gi], local_classes, per_rows)
             )
             across = jnp.where(valid_blk, across, 0)
             outs.append(
@@ -333,6 +412,7 @@ def _disseminate_matching_dist(
     receptive: jax.Array,
     k_push: jax.Array,
     k_pull: jax.Array,
+    transport=None,
 ) -> tuple[jax.Array, jax.Array]:
     """The sharded matching dissemination core; returns (incoming, msgs).
 
@@ -360,6 +440,7 @@ def _disseminate_matching_dist(
             plan, mesh, tx, answer, cfg.msg_slots, k_push,
             receptive_rows=rec_rows,
             do_push=True, do_pull=(cfg.mode == "push_pull"),
+            transport=transport,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + msgs
@@ -373,7 +454,7 @@ def _disseminate_matching_dist(
             msgs_sent = msgs_sent + fresh_msgs
     if cfg.mode == "flood":
         incoming = incoming | _matching_flood_dist(
-            plan, mesh, transmit, cfg.msg_slots
+            plan, mesh, transmit, cfg.msg_slots, transport=transport
         )
         deg = state.row_ptr[1:] - state.row_ptr[:-1]
         msgs_sent = msgs_sent + jnp.sum(
@@ -389,6 +470,8 @@ def gossip_round_dist_matching(
     mesh: Mesh,
     scenario=None,
     growth=None,
+    transport=None,
+    collect_ici: bool = False,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -438,25 +521,52 @@ def gossip_round_dist_matching(
     if scenario is None:
         incoming, msgs_sent = _disseminate_matching_dist(
             state, cfg, plan, mesh, transmit, transmitter, receptive,
-            k_push, k_pull,
+            k_push, k_pull, transport,
         )
-        return advance_round(
+        out = advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
             k_join, receptive, growth=growth,
         )
+        if not collect_ici:
+            return out
+        return (*out, _ici_matching(state, cfg, plan, transport, transmit,
+                                    transmitter, receptive))
     from tpu_gossip.faults.inject import scenario_dissemination
 
     def deliver(tx, tr, rc, k_dpush, k_dpull):
         return _disseminate_matching_dist(
-            state, cfg, plan, mesh, tx, tr, rc, k_dpush, k_dpull
+            state, cfg, plan, mesh, tx, tr, rc, k_dpush, k_dpull, transport
         )
 
     incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
         scenario, state, rnd, transmit, transmitter, receptive,
         k_push, k_pull, deliver,
     )
-    return advance_round(
+    out = advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth,
     )
+    if not collect_ici:
+        return out
+    return (*out, _ici_matching(state, cfg, plan, transport, tx_eff,
+                                transmitter, receptive))
+
+
+def _ici_matching(state, cfg, plan, transport, transmit, transmitter,
+                  receptive):
+    """The analytic counter's view of one matching round: the same plane
+    masks ``_disseminate_matching_dist`` feeds the exchange (fault-free
+    single-pass model on the effective transmit plane)."""
+    from tpu_gossip.dist.transport import ici_round_matching
+    from tpu_gossip.sim.engine import kernel_path_masks
+
+    if cfg.mode == "flood":
+        return ici_round_matching(plan, transport, cfg.msg_slots, transmit,
+                                  None)
+    tx, answer, _ = kernel_path_masks(
+        state, cfg, transmit, transmitter, receptive
+    )
+    if cfg.mode != "push_pull":
+        answer = None  # the pull direction (and its extra plane) never runs
+    return ici_round_matching(plan, transport, cfg.msg_slots, tx, answer)

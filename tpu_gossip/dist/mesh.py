@@ -515,6 +515,7 @@ def _exchange(
     fanout: int,
     blocked_rows: jax.Array | None = None,
     shard_plan: ShardPlans | None = None,
+    transport=None,
 ) -> tuple[jax.Array, jax.Array]:
     """One bucketed all_to_all fan-out; returns (incoming, msgs_per_shard).
 
@@ -531,7 +532,20 @@ def _exchange(
     buckets. Everything upstream (activation draws, all_to_all, stale
     filter, msgs accounting) is unchanged, so the two receive paths are
     bit-identical in output and billing.
+
+    ``transport`` (a :class:`~tpu_gossip.dist.transport.Transport` built
+    for this graph) lane-gates the all_to_all on the occupancy header:
+    occupied payload words — occupancy read PRE-activation from the
+    transmit plane, so no draw is consumed — compact into the static
+    worst-case buffer and scatter back into the exact dense receive
+    buffer, behind one ``lax.cond`` that falls back to the dense lane
+    whenever the header proves the budget would overflow. Everything
+    downstream of the collective (stale filter, billing, both receive
+    paths) is shared, so sparse rounds stay bit-identical.
     """
+    from tpu_gossip.dist.transport import (
+        compact_index, gather_compact, occupancy_counts, scatter_compact,
+    )
     from tpu_gossip.kernels.pallas_segment import (
         _slot_groups, pack_words, stream_segment_or, unpack_words,
     )
@@ -546,6 +560,9 @@ def _exchange(
         blocked_rows = jnp.zeros(transmit.shape[0], dtype=bool)
     if shard_plan is not None:
         shard_plan.check_matches(sg)
+    sparse_on = transport is not None and transport.active
+    if transport is not None:
+        transport.check_matches_graph(sg)
     plan_args = () if shard_plan is None else (
         shard_plan.tile_block, shard_plan.first_visit,
         shard_plan.offs, shard_plan.window_idx,
@@ -558,8 +575,10 @@ def _exchange(
         in_specs=(P(AXIS),) * (8 + len(plan_args)),
         out_specs=(P(AXIS), P(AXIS)),
         # the kernel path launches pallas_call with shard-varying prefetch
-        # tables, which the varying-axes checker cannot type (see _launch)
-        check_vma=shard_plan is None,
+        # tables, which the varying-axes checker cannot type (see _launch);
+        # the sparse lane nests collectives under lax.cond on a pmax'd
+        # predicate — replicated control the checker cannot type either
+        check_vma=shard_plan is None and not sparse_on,
     )
     def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, src_deg, key_blk,
            blocked_blk, *plan_blks):
@@ -601,9 +620,43 @@ def _exchange(
             # per-direction billing rides two word bits alongside the words
             acts = act_p.astype(jnp.int32) | (act_q.astype(jnp.int32) << 1)
             payload = jnp.concatenate([payload, acts[:, :, None]], axis=-1)
-        received = jax.lax.all_to_all(
-            payload, AXIS, split_axis=0, concat_axis=0, tiled=True
-        )  # received[s'] = bucket shard s' packed for me
+        if not sparse_on:
+            received = jax.lax.all_to_all(
+                payload, AXIS, split_axis=0, concat_axis=0, tiled=True
+            )  # received[s'] = bucket shard s' packed for me
+        else:
+            # PRE-activation occupancy: an entry carries bytes only if its
+            # sender's packed word is nonzero — deterministic in transmit,
+            # a superset of the post-activation nonzeros (activation only
+            # zeroes), and the same quantity the analytic counter reads.
+            # The merged billing word is excluded on purpose: an active
+            # edge whose payload words are all zero contributes nothing to
+            # any popcount, so reconstructing its acts bits as 0 changes
+            # neither delivery nor billing.
+            occ = valid & (vals != 0).any(-1)
+            counts = occupancy_counts(occ)  # (S,) — the header row
+            cap = transport.budget
+            # header exchange: one pmax makes the gate identical on every
+            # shard, so the cond's collectives stay replicated-control
+            fits = jax.lax.pmax(jnp.max(counts), AXIS) <= cap
+
+            def compact_lane():
+                idx = compact_index(occ, cap)  # (S, C), sentinel b
+                cvals = gather_compact(payload, idx)  # (S, C, G')
+                idx_r = jax.lax.all_to_all(
+                    idx, AXIS, split_axis=0, concat_axis=0, tiled=True
+                )
+                cvals_r = jax.lax.all_to_all(
+                    cvals, AXIS, split_axis=0, concat_axis=0, tiled=True
+                )
+                return scatter_compact(idx_r, cvals_r, b)
+
+            def dense_lane():
+                return jax.lax.all_to_all(
+                    payload, AXIS, split_axis=0, concat_axis=0, tiled=True
+                )
+
+            received = jax.lax.cond(fits, compact_lane, dense_lane)
         if merged:
             acts_r = received[:, :, g_count]
             received = received[:, :, :g_count]
@@ -670,6 +723,7 @@ def _disseminate_bucketed(
     receptive: jax.Array,
     k_push: jax.Array,
     k_pull: jax.Array,
+    transport=None,
 ) -> tuple[jax.Array, jax.Array]:
     """The bucketed engine's dissemination core; returns (incoming, msgs).
 
@@ -708,7 +762,7 @@ def _disseminate_bucketed(
         inc, msgs = _exchange(
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "push_pull", cfg.fanout, blocked_rows=blocked,
-            shard_plan=shard_plan,
+            shard_plan=shard_plan, transport=transport,
         )
         incoming = incoming | inc
         # delivered bits + one request per pulling peer, mirroring the local
@@ -723,6 +777,7 @@ def _disseminate_bucketed(
             # graftlint: disable=key-linearity -- exclusive with the merged_pp arm at trace time (static cfg.mode dispatch): one split(k_push) per trace
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "push", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
+            transport=transport,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
@@ -731,6 +786,7 @@ def _disseminate_bucketed(
         inc, msgs = _exchange(
             static_answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
             "pull", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
+            transport=transport,
         )
         incoming = incoming | inc
         pulls = (sg.deg > 0) & receptive.any(-1)
@@ -741,7 +797,7 @@ def _disseminate_bucketed(
         inc, msgs = _exchange(
             # graftlint: disable=key-linearity -- flood excludes both push arms above at trace time; one split(k_push) per trace
             transmit, sg, jax.random.split(k_push, sg.n_shards), mesh,
-            "flood", cfg.fanout, shard_plan=shard_plan,
+            "flood", cfg.fanout, shard_plan=shard_plan, transport=transport,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
@@ -764,6 +820,8 @@ def gossip_round_dist(
     shard_plan: ShardPlans | None = None,
     scenario=None,
     growth=None,
+    transport=None,
+    collect_ici: bool = False,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -782,7 +840,15 @@ def gossip_round_dist(
     and distribution-equal for the bucketed engine (its baseline
     contract). ``growth`` (growth/) admits join batches through the
     shared ``advance_round`` stage with the same global-shape guarantee —
-    growing swarms keep each engine family's parity contract."""
+    growing swarms keep each engine family's parity contract.
+
+    ``transport`` (dist/transport.py) lane-gates the exchange's
+    collectives on a per-round occupancy header — it reorders bytes,
+    never draws, so every parity contract above holds verbatim under
+    ``transport=sparse`` (tests/sim/test_sparse_transport.py).
+    ``collect_ici`` (static) appends the round's analytic ICI word
+    accounting as a third output (:class:`~tpu_gossip.dist.transport.
+    IciRound`)."""
     from tpu_gossip.core.matching_topology import MatchingPlan
 
     if isinstance(sg, MatchingPlan):
@@ -793,7 +859,9 @@ def gossip_round_dist(
                 "shard_plan=None"
             )
         return gossip_round_dist_matching(state, cfg, sg, mesh,
-                                          scenario=scenario, growth=growth)
+                                          scenario=scenario, growth=growth,
+                                          transport=transport,
+                                          collect_ici=collect_ici)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -807,33 +875,66 @@ def gossip_round_dist(
     if scenario is None:
         incoming, msgs_sent = _disseminate_bucketed(
             state, cfg, sg, mesh, shard_plan, transmit, transmitter,
-            receptive, k_push, k_pull,
+            receptive, k_push, k_pull, transport,
         )
-        return advance_round(
+        out = advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
             k_join, receptive, growth=growth,
         )
+        if not collect_ici:
+            return out
+        return (*out, _ici_bucketed(state, cfg, sg, transport, transmit,
+                                    transmitter))
     from tpu_gossip.faults.inject import scenario_dissemination
 
     def deliver(tx, tr, rc, k_dpush, k_dpull):
         return _disseminate_bucketed(
-            state, cfg, sg, mesh, shard_plan, tx, tr, rc, k_dpush, k_dpull
+            state, cfg, sg, mesh, shard_plan, tx, tr, rc, k_dpush, k_dpull,
+            transport,
         )
 
     incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
         scenario, state, rnd, transmit, transmitter, receptive,
         k_push, k_pull, deliver,
     )
-    return advance_round(
+    out = advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth,
     )
+    if not collect_ici:
+        return out
+    # fault-free single-pass model on the effective (post-blackout)
+    # transmit plane — see IciRound's docstring for the approximation
+    return (*out, _ici_bucketed(state, cfg, sg, transport, tx_eff,
+                                transmitter))
+
+
+def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
+    """The analytic counter's view of one bucketed round: the same plane
+    masks ``_disseminate_bucketed`` applies, reduced to per-row
+    nonzero-word indicators."""
+    from tpu_gossip.dist.transport import ici_round_bucketed
+    from tpu_gossip.kernels.pallas_segment import _slot_groups
+
+    n_words = len(_slot_groups(cfg.msg_slots))
+    rewiring = cfg.rewire_slots > 0 and cfg.mode in ("push", "push_pull")
+    merged = cfg.mode == "push_pull" and not cfg.forward_once
+    tx_any = transmit.any(-1)
+    ans_any = None
+    if cfg.mode != "flood":
+        if rewiring:
+            tx_any = tx_any & ~state.rewired
+        if cfg.mode == "push_pull" and not merged:
+            ans_any = (state.seen & transmitter).any(-1)
+            if rewiring:
+                ans_any = ans_any & ~state.rewired
+    return ici_round_bucketed(sg, transport, n_words, tx_any, ans_any, merged)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_rounds"),
+    static_argnames=("cfg", "mesh", "num_rounds", "collect_ici"),
     donate_argnames=("state",),
 )
 def simulate_dist(
@@ -845,6 +946,8 @@ def simulate_dist(
     shard_plan: ShardPlans | None = None,
     scenario=None,
     growth=None,
+    transport=None,
+    collect_ici: bool = False,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -853,12 +956,19 @@ def simulate_dist(
     every call — pass ``clone_state(state)`` to keep the input alive.
     ``scenario`` threads a compiled fault schedule (faults/) through the
     scan, exactly as in the local engine; ``growth`` threads a compiled
-    admission schedule (growth/) the same way.
+    admission schedule (growth/) the same way. ``transport``
+    (dist/transport.py) selects the sparsity-adaptive exchange;
+    ``collect_ici`` (static) returns ``(state, (stats, ici))`` with the
+    per-round analytic ICI word trajectory stacked alongside the stats.
     """
 
     def body(carry, _):
-        nxt, stats = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
-                                       scenario, growth)
+        out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
+                                scenario, growth, transport, collect_ici)
+        if collect_ici:
+            nxt, stats, ici = out
+            return nxt, (stats, ici)
+        nxt, stats = out
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -866,7 +976,7 @@ def simulate_dist(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "max_rounds", "slot"),
+    static_argnames=("cfg", "mesh", "max_rounds", "slot", "collect_ici"),
     donate_argnames=("state",),
 )
 def run_until_coverage_dist(
@@ -880,6 +990,8 @@ def run_until_coverage_dist(
     shard_plan: ShardPlans | None = None,
     scenario=None,
     growth=None,
+    transport=None,
+    collect_ici: bool = False,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
@@ -887,15 +999,35 @@ def run_until_coverage_dist(
     ``clone_state(state)`` to keep the input alive. ``scenario`` injects
     a compiled fault schedule (faults/); rounds past its horizon run
     quiescent. ``growth`` admits join batches (growth/); rounds past its
-    schedule run fixed-n.
+    schedule run fixed-n. ``transport`` selects the sparsity-adaptive
+    exchange (dist/transport.py); ``collect_ici`` (static) returns
+    ``(state, totals)`` — an :class:`~tpu_gossip.dist.transport.IciTotals`
+    summed over rounds in the loop carry (the while form keeps no
+    per-round history; the hi/lo int32 pair stays exact past int32, where
+    a 1M matching run wraps within ~60 rounds — read it with
+    ``totals.words()``).
     """
+    from tpu_gossip.dist.transport import accumulate_ici, zero_ici_totals
 
-    def cond(st: SwarmState) -> jax.Array:
+    def cond_plain(st: SwarmState) -> jax.Array:
         return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)
 
-    def body(st: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan, scenario,
-                                   growth)
-        return nxt
+    if not collect_ici:
 
-    return jax.lax.while_loop(cond, body, state)
+        def body(st: SwarmState) -> SwarmState:
+            nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
+                                       scenario, growth, transport)
+            return nxt
+
+        return jax.lax.while_loop(cond_plain, body, state)
+
+    def cond(carry) -> jax.Array:
+        return cond_plain(carry[0])
+
+    def body_ici(carry):
+        st, acc = carry
+        nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
+                                        scenario, growth, transport, True)
+        return nxt, accumulate_ici(acc, ici)
+
+    return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))
