@@ -60,9 +60,11 @@ gather/scatter access rate, not FLOPs (SURVEY.md §5.1 accounting).
 Every record carries ``lint_clean``: the graftlint AST-rule verdict
 (tpu_gossip/analysis, docs/static_analysis.md) for the tree that produced
 the numbers — so a benchmark artifact from an invariant-dirty tree is
-visibly marked. ``--quick`` runs never clobber a full run's measurements,
-but they DO refresh the ``lint_clean``/``lint`` fields in
-BENCH_DETAIL.json. The r5 ``patch_note`` hand-patch mechanism is retired:
+visibly marked — plus ``lint_deep_s``, the combined rules + contract
+audit + jaxpr deep-tier wall time measured in a subprocess (the quantity
+the CI lint-deep job budgets under 120 s). ``--quick`` runs never clobber
+a full run's measurements, but they DO refresh the
+``lint_clean``/``lint``/``lint_deep_s`` fields in BENCH_DETAIL.json. The r5 ``patch_note`` hand-patch mechanism is retired:
 full runs emit no patch/provenance fields (the record IS what this script
 measured), and the committed record's ``provenance_note`` — disclosing
 the r5 entries that were hand-re-measured — rides along until the next
@@ -519,16 +521,22 @@ def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
     }
 
 
-def _lint_status() -> dict:
-    """graftlint verdict for the tree being benchmarked (AST rules only —
-    sub-second; the eval_shape contract audit belongs to CI, not to every
-    bench invocation). Never raises: a crashed linter is itself recorded,
-    not silently dropped."""
+def _lint_status(deep: bool = True) -> dict:
+    """graftlint verdict for the tree being benchmarked. AST rules run
+    in-process (sub-second); the combined run — rules + contract audit +
+    jaxpr deep tier — runs in a SUBPROCESS, because its entry-point
+    matrix needs an 8-CPU mesh and this process's device layout must stay
+    whatever the operator configured for the bench. ``lint_deep_s`` is
+    that combined wall time, the same quantity the CI lint-deep job
+    budgets (<120 s); ``deep=False`` skips the subprocess (fast unit
+    tests). Never raises: a crashed linter is itself recorded, not
+    silently dropped."""
+    out: dict
     try:
         from tpu_gossip.analysis import run_repo_lint
 
         res = run_repo_lint()
-        return {
+        out = {
             "lint_clean": bool(res["clean"]),
             "lint": {
                 "new_findings": len(res["new"]),
@@ -538,6 +546,31 @@ def _lint_status() -> dict:
         }
     except Exception as e:  # noqa: BLE001 — record, don't kill the bench
         return {"lint_clean": False, "lint": {"error": repr(e)[:200]}}
+    if not deep:
+        return out
+    try:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_gossip.analysis", "--deep",
+             "--format=json"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        rep = json.loads(proc.stdout)
+        out["lint_deep_s"] = round(time.perf_counter() - t0, 1)
+        out["lint"]["deep_clean"] = bool(rep["clean"]) and proc.returncode == 0
+        out["lint"]["deep_elapsed_seconds"] = rep.get("elapsed_seconds")
+    except Exception as e:  # noqa: BLE001 — record, don't kill the bench
+        out["lint_deep_s"] = None
+        out["lint"]["deep_error"] = repr(e)[:200]
+    return out
 
 
 def _timed_coverage(run, state, n: int, reps: int):
@@ -893,6 +926,8 @@ def main(argv: list[str] | None = None) -> int:
                     rec = {}  # corrupt record: rebuild the lint stub
             rec["lint_clean"] = lint_status["lint_clean"]
             rec["lint"] = lint_status["lint"]
+            if "lint_deep_s" in lint_status:
+                rec["lint_deep_s"] = lint_status["lint_deep_s"]
             with open(detail_path, "w") as f:
                 json.dump(rec, f, indent=1, sort_keys=True)
                 f.write("\n")

@@ -38,6 +38,34 @@ def cast_traced(x, n: int):
 
 
 @jax.jit
+def cast_traced_reduction(x):
+    # int() over a traced VALUE is a sync even when static-looking
+    # attributes appear elsewhere in the function
+    rank = x.ndim
+    return x * int(x.sum()) * rank
+
+
+@jax.jit
+def cast_param_before_static_rebind(x, rank):
+    # `rank` is a TRACED parameter at this float() — the later static
+    # rebind must not retroactively exempt the sync above it
+    bad = float(rank)
+    rank = int(x.ndim)
+    return x * bad * rank
+
+
+@jax.jit
+def cast_derived_from_rebound(x, y):
+    # `c` derives from the traced binding of `b`; b's later static rebind
+    # must not transitively exempt float(c) — the ambiguity drop has to
+    # propagate to derived names
+    b = y
+    c = b * 2
+    b = int(x.ndim)
+    return x * float(c) * b
+
+
+@jax.jit
 def item_sync(x):
     return x.sum().item()  # .item() forces a device->host sync
 

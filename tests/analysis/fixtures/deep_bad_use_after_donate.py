@@ -1,0 +1,47 @@
+"""Fixture: deep-use-after-donate (AST side) must flag every read here.
+
+Named ``deep_*`` (not ``bad_*``) deliberately: the plain-rules CLI glob
+tests run every ``bad_*`` fixture WITHOUT ``--deep`` and expect exit 1 —
+these reads are invisible to the AST rules and only the deep tier's
+read-after-donate scan reports them.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def step(state):
+    return state
+
+
+def straight_line_read(state):
+    out = step(state)
+    return out, state.rng  # read after donation: buffers deleted
+
+
+def branch_falls_through(state, flag):
+    if flag:
+        step(state)  # donates on this arm, no return
+    return state  # the fall-through read sees deleted buffers when flag
+
+
+def read_in_error_path(state, check):
+    out = step(state)
+    if check:
+        raise ValueError(f"bad state: {state}")  # the ship-a-bug shape
+    return out
+
+
+def loop_cross_iteration(states_cfg, n):
+    acc = 0.0
+    for _ in range(n):
+        acc += float(states_cfg.coverage)  # iteration k+1 reads k's donation
+        step(states_cfg)
+    return acc
+
+
+def keyword_form(state):
+    step(state=state)
+    return state.round  # donation via keyword argument still counts

@@ -1,0 +1,54 @@
+"""Fixture: deep-use-after-donate (AST side) must stay SILENT here —
+every shape below is a sanctioned donation idiom (false-positive guard).
+"""
+
+import functools
+
+import jax
+
+from tpu_gossip.core.state import clone_state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def step(state):
+    return state
+
+
+def threaded(state, n):
+    for _ in range(n):
+        state = step(state)  # rebinding from the result: the idiom
+    return state
+
+
+def cloned_keepalive(state):
+    out = step(clone_state(state))  # the clone dies, the input survives
+    return out, state.rng
+
+
+def early_return_dispatch(state, fast):
+    if fast:
+        return step(state)  # this arm never falls through
+    return state  # reads the UNdonated input: a different control path
+
+
+def read_before(state):
+    cov = state.coverage
+    out = step(state)
+    return out, cov  # everything needed was read BEFORE the call
+
+
+def rebound_in_both_arms(state, flag):
+    if flag:
+        state = step(state)
+    else:
+        state = step(state)
+    return state  # both arms rebind: no deleted handle survives
+
+
+def nested_scope_is_its_own(state):
+    out = step(state)
+
+    def reader(s):
+        return s.rng  # own-scope parameter, not the donated outer name
+
+    return out, reader(out)

@@ -14,6 +14,22 @@ def static_shape_casts(x):
     return x + float(len(x.shape))
 
 
+@jax.jit
+def static_aval_attribute_casts(x):
+    # .ndim/.dtype/.itemsize are aval metadata, as trace-time static as
+    # .shape — the deep tier's jaxpr helpers size byte budgets this way
+    rank = int(x.ndim)
+    width = int(x.dtype.itemsize)
+    bits = int(jnp.finfo(x.dtype).bits) if x.dtype == jnp.float32 else 32
+    return x * float(rank * width * bits)
+
+
+@jax.jit
+def static_byte_budget(x):
+    budget = int(x.size * x.itemsize // 8)  # byte sizing off static attrs
+    return x + float(budget)
+
+
 @functools.partial(jax.jit, static_argnames=("d_max",))
 def static_argname_cast(x, d_max: int):
     return jnp.minimum(x, float(d_max))  # static arg: a host int at trace

@@ -5,8 +5,11 @@ from tpu_gossip.analysis.baseline import load_baseline, split_new, write_baselin
 from tpu_gossip.analysis.registry import Finding
 
 
-def _f(file, rule, msg, line=3):
-    return Finding(file=file, line=line, col=1, rule=rule, message=msg)
+def _f(file, rule, msg, line=3, qualname=""):
+    return Finding(
+        file=file, line=line, col=1, rule=rule, message=msg,
+        qualname=qualname,
+    )
 
 
 def test_round_trip(tmp_path):
@@ -18,6 +21,66 @@ def test_round_trip(tmp_path):
     write_baseline(p, findings)
     loaded = load_baseline(p)
     assert loaded == {f.baseline_key for f in findings}
+
+
+def test_qualname_round_trip(tmp_path):
+    """Identity anchors on (file, rule, qualname) when the finding carries
+    a qualname: the write/load cycle preserves exactly that key."""
+    p = tmp_path / "b.toml"
+    findings = [
+        _f("a.py", "key-linearity", "msg will drift", qualname="simulate"),
+        _f("a.py", "trace-purity", "another", qualname="run.body"),
+    ]
+    write_baseline(p, findings)
+    loaded = load_baseline(p)
+    assert loaded == {
+        ("a.py", "key-linearity", "simulate"),
+        ("a.py", "trace-purity", "run.body"),
+    }
+    assert loaded == {f.baseline_key for f in findings}
+
+
+def test_qualname_identity_survives_message_and_line_drift(tmp_path):
+    """The satellite's point: an unrelated edit that shifts lines or
+    reworded shapes/values inside the message must not churn the baseline
+    — (rule, module, qualname) is the stable identity."""
+    p = tmp_path / "b.toml"
+    write_baseline(
+        p, [_f("a.py", "r", "old message (128, 32)", line=3, qualname="fn")]
+    )
+    drifted = _f("a.py", "r", "new message (256, 64)", line=99, qualname="fn")
+    new, old = split_new([drifted], load_baseline(p))
+    assert new == [] and old == [drifted]
+
+
+def test_legacy_message_entries_still_load(tmp_path):
+    """A baseline written by a pre-qualname tree (message-keyed entries)
+    must still suppress findings that carry no qualname."""
+    p = tmp_path / "b.toml"
+    p.write_text(
+        '[[finding]]\nfile = "a.py"\nrule = "r"\nmessage = "legacy"\n'
+    )
+    legacy = _f("a.py", "r", "legacy")
+    new, old = split_new([legacy], load_baseline(p))
+    assert new == [] and old == [legacy]
+
+
+def test_legacy_message_entries_suppress_qualname_findings(tmp_path):
+    """The upgrade path: a pre-qualname baseline entry must keep
+    suppressing after the rule starts attaching qualnames to the SAME
+    finding — otherwise every baselined finding resurrects as new the
+    moment the tree upgrades."""
+    p = tmp_path / "b.toml"
+    p.write_text(
+        '[[finding]]\nfile = "a.py"\nrule = "trace-purity"\n'
+        'message = "float() over traced value"\n'
+    )
+    upgraded = _f(
+        "a.py", "trace-purity", "float() over traced value",
+        qualname="some_fn",
+    )
+    new, old = split_new([upgraded], load_baseline(p))
+    assert new == [] and old == [upgraded]
 
 
 def test_line_numbers_do_not_affect_matching(tmp_path):

@@ -40,6 +40,44 @@ def test_json_format(capsys):
     assert "rules" in data and "elapsed_seconds" in data
 
 
+def test_json_output_identity_sorted(capsys):
+    """--format=json orders findings by (file, rule, qualname, message),
+    NOT by line number — unrelated edits that shift lines must not churn
+    diffs of the machine-readable output (same reason baseline keys drop
+    line numbers)."""
+    rc = main([
+        str(FIXTURES / "bad_key_reuse.py"),
+        str(FIXTURES / "bad_shard_map.py"),
+        "--format=json",
+    ])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    keys = [
+        (f["file"], f["rule"], f.get("qualname", ""), f["message"])
+        for f in data["new"]
+    ]
+    assert keys == sorted(keys), keys
+    # findings from multiple rules/files present: the sort is exercised
+    assert len({k[1] for k in keys}) >= 2
+
+
+def test_deep_flag_on_explicit_paths_lints_ast_side(capsys):
+    """--deep with explicit paths runs the AST-side donation pass (no
+    tracing — fixture linting must not import the fixtures' runtime):
+    the deep_bad fixture fails, the deep_good twin stays clean."""
+    rc = main([str(FIXTURES / "deep_bad_use_after_donate.py"), "--deep"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out
+    assert "deep-use-after-donate" in out.out
+    rc = main([str(FIXTURES / "deep_good_use_after_donate.py"), "--deep"])
+    capsys.readouterr()
+    assert rc == 0
+    # without --deep the read-after-donate is invisible to the AST rules
+    rc = main([str(FIXTURES / "deep_bad_use_after_donate.py")])
+    capsys.readouterr()
+    assert rc == 0
+
+
 def test_fail_on_new_flag_accepted(capsys):
     rc = main(["--no-contracts", "--fail-on-new"])
     capsys.readouterr()

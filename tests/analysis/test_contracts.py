@@ -118,6 +118,29 @@ def test_crashed_check_is_a_finding(monkeypatch):
     assert "check crashed" in findings[0].message
 
 
+def test_distinct_problems_get_distinct_baseline_keys(monkeypatch):
+    """One check covers ~40 matrix entries; baselining a problem on one
+    entry must not suppress a future problem on a DIFFERENT entry — the
+    qualname carries the sub-entry prefix, not just the check name."""
+    from tpu_gossip.analysis import contracts
+
+    def two_problems():
+        return [
+            "local[xla,push,m=1]: pytree structure changed: a != b",
+            "local[staircase,push,m=1]: stats dtype drifted",
+        ]
+
+    monkeypatch.setitem(contracts.AUDIT_CHECKS, "fake", two_problems)
+    findings = audit_contracts(names=["fake"])
+    assert len(findings) == 2
+    keys = {f.baseline_key for f in findings}
+    assert len(keys) == 2, keys
+    assert {f.qualname for f in findings} == {
+        "fake.local[xla,push,m=1]",
+        "fake.local[staircase,push,m=1]",
+    }
+
+
 @pytest.mark.parametrize("name", sorted(AUDIT_CHECKS))
 def test_each_check_runs_standalone(name):
     findings = audit_contracts(names=[name])

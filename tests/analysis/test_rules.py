@@ -63,7 +63,13 @@ def test_trace_impurity_flagged():
     msgs = "\n".join(f.message for f in findings)
     for needle in (
         "time.time", "random.random", "numpy.random.uniform",
-        "numpy.asarray", "float()", ".item()", "time.perf_counter",
+        "numpy.asarray", "float()", "int()", ".item()",
+        "time.perf_counter",
+        # a traced parameter read BEFORE its static rebind is still a sync
+        "cast_param_before_static_rebind",
+        # the ambiguity drop propagates to names DERIVED from the traced
+        # binding (b = y; c = b*2; b = int(x.ndim) — float(c) syncs)
+        "cast_derived_from_rebound",
     ):
         assert needle in msgs, f"missing {needle} in:\n{msgs}"
 

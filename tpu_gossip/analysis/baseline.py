@@ -1,9 +1,13 @@
 """lint_baseline.toml: suppressions for pre-existing findings.
 
 The baseline lets the repo lint clean from day one while NEW violations
-fail CI: a finding whose (file, rule, message) triple appears here is
-reported as "baselined" and doesn't affect the exit code. Entries are
-matched WITHOUT line numbers so edits above a finding don't resurrect it.
+fail CI: a finding whose identity triple appears here is reported as
+"baselined" and doesn't affect the exit code. Identity is
+(file, rule, qualname) for findings that carry the enclosing function's
+qualname — the stable anchor: line numbers shift under ANY edit above,
+and messages embed shapes/values that drift with unrelated refactors —
+with (file, rule, message) as the legacy form for qualname-less findings
+(old baselines keep loading). Line/col never participate.
 
 The committed baseline should stay empty (or near it): deliberate
 exceptions belong inline as ``# graftlint: disable=<rule> -- <reason>``
@@ -51,7 +55,8 @@ def _quote(s: str) -> str:
 
 
 def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
-    """(file, rule, message) triples from the baseline file; empty set when
+    """Identity triples — (file, rule, qualname) or the legacy
+    (file, rule, message) form — from the baseline file; empty set when
     the file is missing (a fresh checkout without one lints strictly)."""
     p = Path(path)
     if not p.is_file():
@@ -60,7 +65,14 @@ def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
     cur: dict[str, str] | None = None
 
     def flush():
-        if cur is not None and {"file", "rule", "message"} <= set(cur):
+        # identity anchor: qualname when the entry carries one (the stable
+        # post-PR-7 form), else the legacy message form — both load, so a
+        # baseline written by an older tree still suppresses
+        if cur is None or "file" not in cur or "rule" not in cur:
+            return
+        if cur.get("qualname"):
+            entries.add((cur["file"], cur["rule"], cur["qualname"]))
+        elif "message" in cur:
             entries.add((cur["file"], cur["rule"], cur["message"]))
 
     for raw in p.read_text().splitlines():
@@ -97,16 +109,31 @@ def write_baseline(path: str | Path, findings: list[Finding]) -> None:
             "[[finding]]",
             f"file = {_quote(f.file)}",
             f"rule = {_quote(f.rule)}",
-            f"message = {_quote(f.message)}",
         ]
+        # qualname is the identity when present (the message rides along
+        # as a comment for the human reader — the loader skips it);
+        # legacy message form otherwise
+        if f.qualname:
+            lines.append(f"qualname = {_quote(f.qualname)}")
+            first = f.message.splitlines()[0] if f.message else ""
+            if first:
+                lines.append(f"# message: {first}")
+        else:
+            lines.append(f"message = {_quote(f.message)}")
     Path(path).write_text("\n".join(lines) + "\n")
 
 
 def split_new(
     findings: list[Finding], baseline: set[tuple[str, str, str]]
 ) -> tuple[list[Finding], list[Finding]]:
-    """(new, baselined) partition of ``findings``."""
+    """(new, baselined) partition of ``findings``.
+
+    A finding matches under ANY of its identity triples
+    (:attr:`Finding.baseline_keys`): the qualname form, or the legacy
+    message form that pre-qualname baselines were written with.
+    """
     new, old = [], []
     for f in findings:
-        (old if f.baseline_key in baseline else new).append(f)
+        matched = any(k in baseline for k in f.baseline_keys)
+        (old if matched else new).append(f)
     return new, old

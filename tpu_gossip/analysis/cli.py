@@ -31,7 +31,7 @@ from tpu_gossip.analysis.baseline import (
 from tpu_gossip.analysis.registry import RULES, Finding, run_rules
 from tpu_gossip.analysis.walker import ModuleInfo, Project
 
-__all__ = ["main", "lint_paths", "repo_root", "run_repo_lint"]
+__all__ = ["main", "lint_paths", "modules_for", "repo_root", "run_repo_lint"]
 
 _DEFAULT_SCOPE = ("tpu_gossip", "bench.py")
 _EXCLUDE_PARTS = ("tests", ".git", "__pycache__", ".jax_cache")
@@ -65,6 +65,20 @@ def _collect_files(root: Path, paths: list[str]) -> list[Path]:
     return files
 
 
+def modules_for(root: Path, paths: list[str]) -> list[ModuleInfo]:
+    """ModuleInfos for ``paths`` under the repo-relative identity every
+    consumer (AST rules, deep tier, baseline keys) must share — finding
+    files must not depend on how a path was spelled on the command line."""
+    modules = []
+    for f in _collect_files(root, paths):
+        try:
+            rel = str(f.relative_to(root))
+        except ValueError:
+            rel = str(f)
+        modules.append(ModuleInfo(f, rel))
+    return modules
+
+
 def lint_paths(
     paths: list[str],
     *,
@@ -81,13 +95,7 @@ def lint_paths(
     from tpu_gossip.analysis import rules_purity
 
     root = repo_root() if root is None else root
-    modules = []
-    for f in _collect_files(root, paths):
-        try:
-            rel = str(f.relative_to(root))
-        except ValueError:
-            rel = str(f)
-        modules.append(ModuleInfo(f, rel))
+    modules = modules_for(root, paths)
     rules_purity.set_project(Project(modules) if project_wide else None)
     try:
         findings: list[Finding] = []
@@ -98,23 +106,41 @@ def lint_paths(
     return sorted(findings, key=lambda f: (f.file, f.line, f.col, f.rule))
 
 
-def run_repo_lint(with_contracts: bool = False) -> dict:
+def run_repo_lint(
+    with_contracts: bool = False, with_deep: bool = False
+) -> dict:
     """Programmatic entry (bench.py's lint_clean field): returns
     ``{"clean": bool, "new": [...], "baselined": n}`` over the default
-    scope + baseline."""
+    scope + baseline. ``with_deep`` adds the jaxpr deep tier IN-PROCESS
+    (``deep_seconds`` records its wall time; the entry-point traces are
+    shared with the contract audit through one per-invocation cache) —
+    note it forces an 8-device XLA_FLAGS if none is set, so callers that
+    must keep their own device layout (bench.py) run the CLI in a
+    subprocess instead."""
     root = repo_root()
     findings = lint_paths(list(_DEFAULT_SCOPE), root=root)
+    out: dict = {}
+    cache: dict = {}
+    if with_contracts or with_deep:
+        _ensure_multi_device_env()
     if with_contracts:
         from tpu_gossip.analysis.contracts import audit_contracts
 
-        findings = findings + audit_contracts()
+        findings = findings + audit_contracts(cache=cache)
+    if with_deep:
+        from tpu_gossip.analysis.deep import run_deep
+
+        t0 = time.perf_counter()
+        findings = findings + run_deep(cache=cache)
+        out["deep_seconds"] = round(time.perf_counter() - t0, 2)
     baseline = load_baseline(root / DEFAULT_BASELINE)
     new, old = split_new(findings, baseline)
-    return {
+    out.update({
         "clean": not new,
         "new": [f.to_dict() for f in new],
         "baselined": len(old),
-    }
+    })
+    return out
 
 
 def _ensure_multi_device_env() -> None:
@@ -164,6 +190,16 @@ def main(argv: list[str] | None = None) -> int:
         help="run only the contract audit",
     )
     ap.add_argument(
+        "--deep", action="store_true",
+        help="add the jaxpr deep tier (RNG lineage, float-reduction "
+        "order, use-after-donate) — traces the shared entry-point matrix "
+        "once, reusing the contract audit's traces",
+    )
+    ap.add_argument(
+        "--deep-only", action="store_true",
+        help="run only the deep tier",
+    )
+    ap.add_argument(
         "--baseline", default=None,
         help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})",
     )
@@ -199,11 +235,13 @@ def main(argv: list[str] | None = None) -> int:
 
     explicit_paths = bool(args.paths)
     run_contracts = (
-        not args.no_contracts and not explicit_paths and only is None
-    ) or args.contracts_only
+        (not args.no_contracts and not explicit_paths and only is None)
+        or args.contracts_only
+    ) and not args.deep_only
+    run_deep_tier = args.deep or args.deep_only
     t0 = time.perf_counter()
     findings: list[Finding] = []
-    if not args.contracts_only:
+    if not (args.contracts_only or args.deep_only):
         try:
             findings = lint_paths(
                 args.paths or list(_DEFAULT_SCOPE), root=root, rules=only
@@ -211,11 +249,30 @@ def main(argv: list[str] | None = None) -> int:
         except (FileNotFoundError, SyntaxError) as e:
             print(str(e), file=sys.stderr)
             return 2
+    # one per-invocation trace cache: the audit and the deep tier walk the
+    # SAME entry-point matrix (analysis/entrypoints.py) and must pay the
+    # make_jaxpr cost once between them
+    trace_cache: dict = {}
     if run_contracts:
         _ensure_multi_device_env()
         from tpu_gossip.analysis.contracts import audit_contracts
 
-        findings = findings + audit_contracts()
+        findings = findings + audit_contracts(cache=trace_cache)
+    if run_deep_tier:
+        from tpu_gossip.analysis.deep import run_deep
+
+        if explicit_paths:
+            # explicit-path runs lint sources only (fixture linting must
+            # not import the fixtures' runtime): AST-side pass only
+            try:
+                mods = modules_for(root, args.paths)
+            except (FileNotFoundError, SyntaxError) as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            findings = findings + run_deep(modules=mods, trace=False)
+        else:
+            _ensure_multi_device_env()
+            findings = findings + run_deep(cache=trace_cache)
 
     baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
     if args.write_baseline:
@@ -230,14 +287,27 @@ def main(argv: list[str] | None = None) -> int:
     elapsed = time.perf_counter() - t0
 
     if args.format == "json":
+        # identity-stable order (file, rule, qualname, message) — NOT line
+        # numbers, so unrelated edits above a finding don't churn diffs of
+        # the machine-readable output (the same reason baseline keys drop
+        # line numbers)
         print(
             json.dumps(
                 {
                     "clean": not new,
-                    "new": [f.to_dict() for f in new],
-                    "baselined": [f.to_dict() for f in old],
+                    "new": [
+                        f.to_dict() for f in sorted(
+                            new, key=lambda f: f.sort_key
+                        )
+                    ],
+                    "baselined": [
+                        f.to_dict() for f in sorted(
+                            old, key=lambda f: f.sort_key
+                        )
+                    ],
                     "rules": sorted(RULES),
                     "contract_audit": run_contracts,
+                    "deep": run_deep_tier,
                     "elapsed_seconds": round(elapsed, 2),
                 },
                 indent=1,
@@ -251,6 +321,7 @@ def main(argv: list[str] | None = None) -> int:
             f"graftlint: {len(new)} new finding(s), {len(old)} baselined, "
             f"{len(RULES)} rules"
             + (", contract audit on" if run_contracts else "")
+            + (", deep tier on" if run_deep_tier else "")
             + f", {elapsed:.1f}s"
         )
         print(tail, file=sys.stderr)

@@ -1,19 +1,20 @@
-"""Abstract contract audit: jax.eval_shape over every public entry point.
+"""Abstract contract audit: shape/dtype contracts over the entry matrix.
 
 The AST rules catch discipline violations; this pass catches SHAPE and
 DTYPE drift — the class of bug a CPU-only CI cannot execute its way into
 (10M-scale kernels, mesh collectives) but CAN abstractly evaluate in
-milliseconds. Every public entry point is traced with ``jax.eval_shape``
-over a small parameter grid and its declared contract asserted:
+milliseconds. Every public entry point in the shared matrix
+(:mod:`tpu_gossip.analysis.entrypoints` — the same matrix the jaxpr deep
+tier walks) is traced once and its declared contract asserted:
 
 - **round engines** (``gossip_round``, ``simulate``,
-  ``run_until_coverage``, ``gossip_round_dist`` over both the bucketed-CSR
-  and matching mesh engines): the output ``SwarmState`` must carry
-  EXACTLY the input's per-leaf shapes/dtypes — the state pytree is a
-  fixed-point of the round map (anything else breaks ``lax.scan`` /
-  ``while_loop`` carries and checkpoint resume) — and ``RoundStats``
-  fields must be scalars of their declared dtypes (stacked to
-  ``(num_rounds,)`` under ``simulate``).
+  ``run_until_coverage``, ``gossip_round_dist``/``simulate_dist``/
+  ``run_until_coverage_dist`` over both the bucketed-CSR and matching
+  mesh engines): the output ``SwarmState`` must carry EXACTLY the input's
+  per-leaf shapes/dtypes — the state pytree is a fixed-point of the round
+  map (anything else breaks ``lax.scan`` / ``while_loop`` carries and
+  checkpoint resume) — and ``RoundStats`` fields must be scalars of their
+  declared dtypes (stacked to ``(num_rounds,)`` under ``simulate``).
 - **builders** (``matching_powerlaw_graph`` and its sharded twin,
   ``device_powerlaw_graph``): CSR invariants (row_ptr ``(rows+1,)`` int32
   and monotone, col_idx int32, exists bool of row count) checked on
@@ -34,19 +35,28 @@ monkeypatch a deliberate contract break and assert this audit reports it
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Dict
 
+from tpu_gossip.analysis.entrypoints import (  # noqa: F401  (re-exported for
+    _chaos_scenario,  # tests and historical imports)
+    _ctx,
+    _dist_ctx,
+    _growth_plan,
+    _N_DEV,
+    _N_MATCH,
+    dist_guard,
+    entry_points,
+    trace_matrix,
+)
 from tpu_gossip.analysis.registry import Finding
 
 __all__ = ["AUDIT_CHECKS", "audit_contracts", "audit_check"]
 
 AUDIT_CHECKS: Dict[str, Callable[[], list]] = {}
 
-_N_MATCH = 256  # tiny matching build (compile cost: seconds, CPU)
-_N_DEV = 512  # tiny device-CSR build
-_MSG_SLOTS = (1, 16)  # one word group / multi-slot packed group
-_MODES = ("push", "push_pull", "flood")
+# per-invocation trace cache, installed by audit_contracts(cache=...) so a
+# CLI run that also runs the deep tier traces the matrix exactly once
+_ACTIVE_CACHE: dict | None = None
 
 
 def audit_check(name: str):
@@ -81,84 +91,6 @@ def _diff_specs(name: str, got, want, problems: list) -> None:
             )
 
 
-@functools.lru_cache(maxsize=None)
-def _ctx():
-    """Tiny concrete graphs/plans/states shared by all checks (built once)."""
-    import jax
-    import numpy as np
-
-    from tpu_gossip.core.device_topology import device_powerlaw_graph
-    from tpu_gossip.core.matching_topology import matching_powerlaw_graph
-    from tpu_gossip.core.state import SwarmConfig, init_swarm
-    from tpu_gossip.kernels.pallas_segment import build_staircase_plan
-
-    dg = device_powerlaw_graph(_N_DEV, gamma=2.5, key=jax.random.key(0))
-    mg, mplan = matching_powerlaw_graph(
-        _N_MATCH, gamma=2.5, fanout=1, key=jax.random.key(0), export_csr=True
-    )
-    splan = build_staircase_plan(
-        np.asarray(dg.row_ptr), np.asarray(dg.col_idx), fanout=1
-    )
-
-    def state_for(graph, m: int, **cfg_kw):
-        cfg = SwarmConfig(
-            n_peers=graph.n_pad, msg_slots=m, fanout=1, **cfg_kw
-        )
-        st = init_swarm(
-            graph.as_padded_graph(), cfg, origins=[0], exists=graph.exists,
-            key=jax.random.key(0),
-        )
-        return st, cfg
-
-    return {
-        "dg": dg, "mg": mg, "mplan": mplan, "splan": splan,
-        "state_for": state_for,
-    }
-
-
-def _chaos_scenario(n_slots: int, n_real: int):
-    """A non-trivial compiled scenario — every fault class active (loss,
-    delay, partition, blackout, churn burst) — so the scenario-threaded
-    round traces its full structure (two-pass delivery, held buffer,
-    burst churn) under the fixed-point contract."""
-    from tpu_gossip.faults import compile_scenario, scenario_from_dict
-
-    spec = scenario_from_dict({
-        "name": "audit-chaos",
-        "phases": [
-            {"name": "lossy", "start": 0, "end": 2, "loss": 0.2,
-             "delay": 0.2},
-            {"name": "split", "start": 2, "end": 4, "partition": "half"},
-            {"name": "storm", "start": 4, "end": 6, "churn_leave": 0.05,
-             "churn_join": 0.2, "blackout": {"frac": 0.1, "seed": 1}},
-        ],
-    })
-    return compile_scenario(
-        spec, n_peers=n_real, n_slots=n_slots, total_rounds=8
-    )
-
-
-def _growth_plan(n_slots: int, n_initial: int):
-    """A small compiled growth schedule so the growing round traces its
-    full structure (admission slice, Gumbel-top-k draw, registry
-    scatters) under the fixed-point contract — pinning the growth plane
-    exactly the way the chaos scenario pins ``fault_held``."""
-    import numpy as np
-
-    from tpu_gossip.growth import compile_growth
-
-    target = min(n_initial + 32, n_slots)
-    return compile_growth(
-        n_initial=n_initial,
-        target=target,
-        n_slots=n_slots,
-        joins_per_round=4,
-        attach_m=2,
-        admit_rows=np.arange(n_initial, target),
-        max_join_burst=4,
-    )
-
-
 def _stats_contract(stats, problems: list, leading=()) -> None:
     import jax.numpy as jnp
 
@@ -189,6 +121,48 @@ def _stats_contract(stats, problems: list, leading=()) -> None:
             problems.append(
                 f"RoundStats.{field}: dtype {leaf.dtype} != declared {dt}"
             )
+
+
+def _ici_contract(name: str, ici, problems: list) -> None:
+    import jax.numpy as jnp
+
+    from tpu_gossip.dist import transport as tp
+
+    for field in tp.IciRound._fields:
+        leaf = getattr(ici, field, None)
+        if leaf is None:
+            problems.append(f"{name}: IciRound lost field {field!r}")
+        elif tuple(leaf.shape) != () or leaf.dtype != jnp.int32:
+            problems.append(
+                f"{name}: IciRound.{field} {tuple(leaf.shape)}/"
+                f"{leaf.dtype} != declared scalar int32"
+            )
+
+
+def _check_matrix_entries(check_name: str) -> list:
+    """The shared fixed-point/stats/ici contract over every matrix entry
+    owned by ``check_name`` — one traversal serves all four round checks."""
+    eps = [ep for ep in entry_points() if ep.audit_check == check_name]
+    problems: list[str] = []
+    for name, te in trace_matrix(eps, cache=_ACTIVE_CACHE).items():
+        ep = te.ep
+        if te.error is not None:
+            problems.append(f"{name}: abstract eval failed: {te.error}")
+            continue
+        out = te.out_shape
+        ici = None
+        if ep.has_ici:
+            out_st, out_stats, ici = out
+        elif ep.stats_leading is None:
+            out_st, out_stats = out, None
+        else:
+            out_st, out_stats = out
+        _diff_specs(name, _spec_tree(out_st), _spec_tree(te.state), problems)
+        if out_stats is not None:
+            _stats_contract(out_stats, problems, leading=ep.stats_leading)
+        if ici is not None:
+            _ici_contract(name, ici, problems)
+    return problems
 
 
 # --------------------------------------------------------------- builders
@@ -279,120 +253,7 @@ def _check_sharded_builder() -> list:
 # ----------------------------------------------------------- round engines
 @audit_check("gossip_round_local")
 def _check_gossip_round() -> list:
-    import jax
-
-    from tpu_gossip.sim import engine
-
-    problems: list[str] = []
-    ctx = _ctx()
-    grids = []
-    for m in _MSG_SLOTS:
-        for mode in _MODES:
-            grids.append((ctx["dg"], None, m, mode, "xla", {}))
-            grids.append((ctx["dg"], ctx["splan"], m, mode, "pallas", {}))
-            grids.append((ctx["mg"], ctx["mplan"], m, mode, "matching", {}))
-    # churn + SIR shapes ride the same fixed-point contract
-    churn = dict(
-        churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
-    )
-    grids.append((ctx["dg"], None, 16, "push_pull", "xla-churn", churn))
-    grids.append(
-        (ctx["dg"], None, 16, "push_pull", "xla-sir",
-         dict(sir_recover_rounds=8))
-    )
-    grids.append(
-        (ctx["dg"], None, 16, "push_pull", "xla-churn-compact",
-         {**churn, "rewire_compact_cap": 64})
-    )
-    for graph, plan, m, mode, label, extra in grids:
-        st, cfg = ctx["state_for"](graph, m, mode=mode, **extra)
-        name = f"gossip_round[{label},{mode},m={m}]"
-        try:
-            out_st, out_stats = jax.eval_shape(
-                lambda s: engine.gossip_round(s, cfg, plan), st
-            )
-        except Exception as e:  # noqa: BLE001 — any trace failure is a finding
-            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
-            continue
-        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
-        _stats_contract(out_stats, problems)
-    # every tail implementation (kernels/round_tail.py) must keep the round
-    # a state fixed point — the rail that makes aggressive fusion safe: a
-    # tail that drops, reshapes, or re-types a slot array cannot reach a
-    # scan/while_loop carry without failing here first. Churn + SIR ride
-    # along so the fresh-mask and recovery branches are traced too.
-    st, cfg = ctx["state_for"](
-        ctx["dg"], 16, mode="push_pull", sir_recover_rounds=4, **churn
-    )
-    for tail in ("reference", "fused", "pallas"):
-        name = f"gossip_round[tail={tail}]"
-        try:
-            out_st, out_stats = jax.eval_shape(
-                lambda s, t=tail: engine.gossip_round(s, cfg, tail=t), st
-            )
-        except Exception as e:  # noqa: BLE001
-            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
-            continue
-        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
-        _stats_contract(out_stats, problems)
-    # chaos scenarios (faults/): a round with every fault class active —
-    # two-pass partition delivery, the delay buffer, blackout masks, burst
-    # churn — must still be a state fixed point on every delivery engine,
-    # or a scenario could never ride a scan/while carry
-    scen = _chaos_scenario(
-        ctx["dg"].n_pad, _N_DEV
-    )
-    for graph, plan, label in (
-        (ctx["dg"], None, "xla"),
-        (ctx["mg"], ctx["mplan"], "matching"),
-    ):
-        scen_g = scen if graph is ctx["dg"] else _chaos_scenario(
-            graph.n_pad, _N_MATCH
-        )
-        st, cfg = ctx["state_for"](
-            graph, 16, mode="push_pull", rewire_slots=2,
-            churn_join_prob=0.02, churn_leave_prob=0.002,
-        )
-        name = f"gossip_round[scenario,{label}]"
-        try:
-            out_st, out_stats = jax.eval_shape(
-                lambda s, p=plan, sc=scen_g: engine.gossip_round(
-                    s, cfg, p, scenario=sc
-                ),
-                st,
-            )
-        except Exception as e:  # noqa: BLE001
-            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
-            continue
-        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
-        _stats_contract(out_stats, problems)
-    # the GROWING round (growth/): admission slice + Gumbel-top-k +
-    # registry scatters must keep the round a state fixed point on every
-    # local delivery engine — a growth plane that reshapes or drops a
-    # registry leaf could never ride a scan/while carry or a checkpoint
-    for graph, plan, label in (
-        (ctx["dg"], None, "xla"),
-        (ctx["dg"], ctx["splan"], "pallas"),
-        (ctx["mg"], ctx["mplan"], "matching"),
-    ):
-        st, cfg = ctx["state_for"](
-            graph, 16, mode="push_pull", rewire_slots=2,
-        )
-        gp = _growth_plan(graph.n_pad, graph.n_pad - 40)
-        name = f"gossip_round[growth,{label}]"
-        try:
-            out_st, out_stats = jax.eval_shape(
-                lambda s, p=plan, g=gp: engine.gossip_round(
-                    s, cfg, p, growth=g
-                ),
-                st,
-            )
-        except Exception as e:  # noqa: BLE001
-            problems.append(f"{name}: abstract eval failed: {e!r:.200}")
-            continue
-        _diff_specs(name, _spec_tree(out_st), _spec_tree(st), problems)
-        _stats_contract(out_stats, problems)
-    return problems
+    return _check_matrix_entries("gossip_round_local")
 
 
 @audit_check("growth_registry_plane")
@@ -434,32 +295,7 @@ def _check_growth_registry() -> list:
 
 @audit_check("simulate_and_coverage")
 def _check_simulate() -> list:
-    import jax
-
-    from tpu_gossip.sim import engine
-
-    problems: list[str] = []
-    ctx = _ctx()
-    st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
-    rounds = 3
-    try:
-        fin, stats = jax.eval_shape(
-            lambda s: engine.simulate(s, cfg, rounds), st
-        )
-        _diff_specs("simulate", _spec_tree(fin), _spec_tree(st), problems)
-        _stats_contract(stats, problems, leading=(rounds,))
-    except Exception as e:  # noqa: BLE001
-        problems.append(f"simulate: abstract eval failed: {e!r:.200}")
-    try:
-        fin = jax.eval_shape(
-            lambda s: engine.run_until_coverage(s, cfg, 0.99, 10), st
-        )
-        _diff_specs(
-            "run_until_coverage", _spec_tree(fin), _spec_tree(st), problems
-        )
-    except Exception as e:  # noqa: BLE001
-        problems.append(f"run_until_coverage: abstract eval failed: {e!r:.200}")
-    return problems
+    return _check_matrix_entries("simulate_and_coverage")
 
 
 @audit_check("pallas_wrappers")
@@ -475,7 +311,7 @@ def _check_kernels() -> list:
     mplan, splan = ctx["mplan"], ctx["splan"]
     n_match, n_dev = _N_MATCH + 1, _N_DEV + 1
     key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
-    for m in _MSG_SLOTS:
+    for m in (1, 16):
         tx_m = jax.ShapeDtypeStruct((n_match, m), jnp.bool_)
         tx_s = jax.ShapeDtypeStruct((n_dev, m), jnp.bool_)
         rec_m = jax.ShapeDtypeStruct((n_match,), jnp.bool_)
@@ -519,7 +355,7 @@ def _check_kernels() -> list:
         for name, thunk, want_shape, billed in cases:
             try:
                 out = jax.eval_shape(thunk)
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — any trace failure is a finding
                 problems.append(f"{name}: abstract eval failed: {e!r:.200}")
                 continue
             inc, msgs = out if billed else (out, None)
@@ -549,149 +385,10 @@ def _check_kernels() -> list:
 
 @audit_check("gossip_round_dist")
 def _check_dist() -> list:
-    import jax
-
-    from tpu_gossip import dist as dist_pkg
-    from tpu_gossip.core import matching_topology as mt
-    from tpu_gossip.core.state import SwarmConfig, init_swarm
-    from tpu_gossip.dist import mesh as mesh_mod
-
-    problems: list[str] = []
-    mesh = dist_pkg.make_mesh()
-    if 128 % mesh.size:
-        return [
-            f"mesh size {mesh.size} does not divide 128 — matching dist "
-            "contract unverifiable on this host (run under "
-            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
-        ]
-    # matching mesh engine: the sharded plan IS the delivery engine
-    g, plan = mt.matching_powerlaw_graph_sharded(
-        _N_MATCH, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
-        export_csr=False,
-    )
-    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull")
-    st = init_swarm(
-        g.as_padded_graph(), cfg, origins=[0], exists=g.exists,
-        key=jax.random.key(0),
-    )
-    try:
-        out_st, out_stats = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(s, cfg, plan, mesh), st
-        )
-        _diff_specs(
-            "gossip_round_dist[matching]",
-            _spec_tree(out_st), _spec_tree(st), problems,
-        )
-        _stats_contract(out_stats, problems)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[matching]: abstract eval failed: {e!r:.200}"
-        )
-    # the mesh round under an active chaos scenario (faults/) — the
-    # bit-identity contract's distributed half must trace with the same
-    # fixed point the local scenario round keeps
-    scen = _chaos_scenario(plan.n, _N_MATCH)
-    try:
-        out_st, out_stats = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(
-                s, cfg, plan, mesh, scenario=scen
-            ),
-            st,
-        )
-        _diff_specs(
-            "gossip_round_dist[matching,scenario]",
-            _spec_tree(out_st), _spec_tree(st), problems,
-        )
-        _stats_contract(out_stats, problems)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[matching,scenario]: abstract eval failed: "
-            f"{e!r:.200}"
-        )
-    # the GROWING mesh round — the membership half of the bit-identity
-    # contract must trace with the same state fixed point on the mesh
-    # (growth edges ride the re-wiring plane, so the config carries slots)
-    gp = _growth_plan(plan.n, plan.n - 40)
-    cfg_g = SwarmConfig(
-        n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull",
-        rewire_slots=2,
-    )
-    st_g = init_swarm(
-        g.as_padded_graph(), cfg_g, origins=[0], exists=g.exists,
-        key=jax.random.key(0),
-    )
-    try:
-        out_st, out_stats = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(
-                s, cfg_g, plan, mesh, growth=gp
-            ),
-            st_g,
-        )
-        _diff_specs(
-            "gossip_round_dist[matching,growth]",
-            _spec_tree(out_st), _spec_tree(st_g), problems,
-        )
-        _stats_contract(out_stats, problems)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[matching,growth]: abstract eval failed: "
-            f"{e!r:.200}"
-        )
-    # bucketed-CSR engine over a partitioned host graph
-    import numpy as np
-
-    from tpu_gossip.core.topology import (
-        build_csr, configuration_model, powerlaw_degree_sequence,
-    )
-
-    rng = np.random.default_rng(0)
-    graph = build_csr(
-        _N_DEV,
-        configuration_model(
-            powerlaw_degree_sequence(_N_DEV, gamma=2.5, rng=rng), rng=rng
-        ),
-    )
-    sg, relabeled, position = mesh_mod.partition_graph(graph, mesh.size, seed=0)
-    cfg2 = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
-    st2 = mesh_mod.init_sharded_swarm(sg, relabeled, position, cfg2, origins=[0])
-    try:
-        out_st, out_stats = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(s, cfg2, sg, mesh), st2
-        )
-        _diff_specs(
-            "gossip_round_dist[bucketed]",
-            _spec_tree(out_st), _spec_tree(st2), problems,
-        )
-        _stats_contract(out_stats, problems)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[bucketed]: abstract eval failed: {e!r:.200}"
-        )
-    # bucketed engine under an active growth schedule
-    cfg3 = SwarmConfig(
-        n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull",
-        rewire_slots=2,
-    )
-    st3 = mesh_mod.init_sharded_swarm(sg, relabeled, position, cfg3, origins=[0])
-    gp_b = _growth_plan(sg.n_pad, sg.n_pad - 40)
-    try:
-        out_st, out_stats = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(
-                s, cfg3, sg, mesh, growth=gp_b
-            ),
-            st3,
-        )
-        _diff_specs(
-            "gossip_round_dist[bucketed,growth]",
-            _spec_tree(out_st), _spec_tree(st3), problems,
-        )
-        _stats_contract(out_stats, problems)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[bucketed,growth]: abstract eval failed: "
-            f"{e!r:.200}"
-        )
-    return problems
+    guard = dist_guard()
+    if guard is not None:
+        return [guard]
+    return _check_matrix_entries("gossip_round_dist")
 
 
 @audit_check("sparse_transport")
@@ -704,22 +401,15 @@ def _check_sparse_transport() -> list:
     concrete half lives in tests/sim/test_sparse_transport.py)."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
-    from tpu_gossip import dist as dist_pkg
-    from tpu_gossip.core import matching_topology as mt
-    from tpu_gossip.core.state import SwarmConfig, init_swarm
-    from tpu_gossip.dist import mesh as mesh_mod
     from tpu_gossip.dist import transport as tp
 
+    guard = dist_guard()
+    if guard is not None:
+        return [guard]
     problems: list[str] = []
-    mesh = dist_pkg.make_mesh()
-    if 128 % mesh.size:
-        return [
-            f"mesh size {mesh.size} does not divide 128 — sparse transport "
-            "contract unverifiable on this host (run under "
-            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
-        ]
+    dctx = _dist_ctx()
+    mesh, plan, sg = dctx["mesh"], dctx["plan"], dctx["sg"]
     # the occupancy header: one shard's per-destination counts must carry
     # the DECLARED spec (header_spec) — the receiver gate and the analytic
     # counter both read it, so a silent dtype/shape drift desynchronizes
@@ -737,22 +427,7 @@ def _check_sparse_transport() -> list:
     except Exception as e:  # noqa: BLE001
         problems.append(f"occupancy_counts: abstract eval failed: {e!r:.200}")
 
-    def ici_contract(name, ici):
-        for field in tp.IciRound._fields:
-            leaf = getattr(ici, field, None)
-            if leaf is None:
-                problems.append(f"{name}: IciRound lost field {field!r}")
-            elif tuple(leaf.shape) != () or leaf.dtype != jnp.int32:
-                problems.append(
-                    f"{name}: IciRound.{field} {tuple(leaf.shape)}/"
-                    f"{leaf.dtype} != declared scalar int32"
-                )
-
-    # matching engine: transport tables + sparse round fixed point
-    g, plan = mt.matching_powerlaw_graph_sharded(
-        _N_MATCH, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
-        export_csr=False,
-    )
+    # matching engine transport tables
     tr = tp.build_transport(plan, mode="sparse")
     if tr.leaf_slots is None or (
         tuple(tr.leaf_slots.shape), str(tr.leaf_slots.dtype)
@@ -779,91 +454,56 @@ def _check_sparse_transport() -> list:
         problems.append(
             f"matching transport: budget {tr.budget} outside (0, per_rows]"
         )
-    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull")
-    st = init_swarm(
-        g.as_padded_graph(), cfg, origins=[0], exists=g.exists,
-        key=jax.random.key(0),
-    )
-    try:
-        out_st, out_stats, ici = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(
-                s, cfg, plan, mesh, transport=tr, collect_ici=True
-            ),
-            st,
-        )
-        _diff_specs(
-            "gossip_round_dist[matching,sparse]",
-            _spec_tree(out_st), _spec_tree(st), problems,
-        )
-        _stats_contract(out_stats, problems)
-        ici_contract("gossip_round_dist[matching,sparse]", ici)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[matching,sparse]: abstract eval failed: "
-            f"{e!r:.200}"
-        )
-    # bucketed engine under transport=sparse
-    from tpu_gossip.core.topology import (
-        build_csr, configuration_model, powerlaw_degree_sequence,
-    )
-
-    rng = np.random.default_rng(0)
-    graph = build_csr(
-        _N_DEV,
-        configuration_model(
-            powerlaw_degree_sequence(_N_DEV, gamma=2.5, rng=rng), rng=rng
-        ),
-    )
-    sg, relabeled, position = mesh_mod.partition_graph(graph, mesh.size, seed=0)
+    # bucketed engine transport budget
     tr_b = tp.build_transport(sg, mode="sparse")
     if not (0 < tr_b.budget <= sg.bucket):
         problems.append(
             f"bucketed transport: budget {tr_b.budget} outside (0, bucket]"
         )
-    cfg2 = SwarmConfig(n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull")
-    st2 = mesh_mod.init_sharded_swarm(sg, relabeled, position, cfg2, origins=[0])
-    try:
-        out_st, out_stats, ici = jax.eval_shape(
-            lambda s: mesh_mod.gossip_round_dist(
-                s, cfg2, sg, mesh, transport=tr_b, collect_ici=True
-            ),
-            st2,
-        )
-        _diff_specs(
-            "gossip_round_dist[bucketed,sparse]",
-            _spec_tree(out_st), _spec_tree(st2), problems,
-        )
-        _stats_contract(out_stats, problems)
-        ici_contract("gossip_round_dist[bucketed,sparse]", ici)
-    except Exception as e:  # noqa: BLE001
-        problems.append(
-            f"gossip_round_dist[bucketed,sparse]: abstract eval failed: "
-            f"{e!r:.200}"
-        )
+    # both engines' sparse rounds: fixed point + IciRound contract
+    problems.extend(_check_matrix_entries("sparse_transport"))
     return problems
 
 
-def audit_contracts(names=None) -> list[Finding]:
-    """Run the contract checks; each problem line becomes one Finding."""
+def audit_contracts(names=None, cache: dict | None = None) -> list[Finding]:
+    """Run the contract checks; each problem line becomes one Finding.
+
+    ``cache`` (name -> TracedEntry) shares entry-point traces with other
+    consumers in the same invocation — the CLI passes one dict to this
+    audit and to the deep tier so the matrix is traced exactly once.
+    """
+    global _ACTIVE_CACHE
     findings: list[Finding] = []
-    for name, check in AUDIT_CHECKS.items():
-        if names is not None and name not in names:
-            continue
-        try:
-            problems = check()
-        except Exception as e:  # noqa: BLE001 — a crashed check must FAIL CI
-            problems = [f"check crashed: {e!r:.300}"]
-        for p in problems:
-            findings.append(
-                Finding(
-                    file=f"<contract:{name}>",
-                    line=0,
-                    col=0,
-                    rule="contract-audit",
-                    message=p,
-                    hint="declared contracts live in "
-                    "tpu_gossip/analysis/contracts.py — fix the entry point "
-                    "or update the declaration WITH the behavior change",
+    _ACTIVE_CACHE = cache
+    try:
+        for name, check in AUDIT_CHECKS.items():
+            if names is not None and name not in names:
+                continue
+            try:
+                problems = check()
+            except Exception as e:  # noqa: BLE001 — a crashed check must FAIL CI
+                problems = [f"check crashed: {e!r:.300}"]
+            for p in problems:
+                # identity anchor: check name + the problem's sub-entry
+                # prefix (matrix entry / table name before the first ':').
+                # The check name ALONE would let one baselined problem
+                # suppress every future distinct problem in the check;
+                # the full message embeds shapes that drift.
+                prefix = p.split(":", 1)[0].strip() if ":" in p else p
+                findings.append(
+                    Finding(
+                        file=f"<contract:{name}>",
+                        line=0,
+                        col=0,
+                        rule="contract-audit",
+                        message=p,
+                        hint="declared contracts live in "
+                        "tpu_gossip/analysis/contracts.py — fix the entry "
+                        "point or update the declaration WITH the behavior "
+                        "change",
+                        qualname=f"{name}.{prefix}",
+                    )
                 )
-            )
+    finally:
+        _ACTIVE_CACHE = None
     return findings

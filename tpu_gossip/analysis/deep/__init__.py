@@ -1,0 +1,84 @@
+"""graftlint deep tier: dataflow passes over traced jaxprs.
+
+The AST rules (tier 1) check source discipline; the contract audit checks
+abstract shapes; this tier checks what the TRACE actually does — the
+level where the bit-identity contract either holds or doesn't. It reuses
+the contract audit's entry-point matrix
+(:mod:`tpu_gossip.analysis.entrypoints`: 3 local engines × modes ×
+scenarios × growth × both mesh engines × sparse transport + the jitted
+loop entries), runs ``jax.make_jaxpr`` once per entry (shared with the
+audit through a per-invocation cache), and applies three passes:
+
+- :mod:`.lineage` (``deep-rng-lineage``) — every draw descends from
+  ``state.rng`` through split/fold_in; constant fold_in salts must be
+  registered in :mod:`tpu_gossip.core.streams`; no key value consumed
+  twice; no draw inside a ``shard_map`` body.
+- :mod:`.reductions` (``deep-float-reduction``) — cross-replica float
+  reductions only where the allowlist licenses them (the γ-MLE track is
+  the one documented 1-ULP exception).
+- :mod:`.donation` (``deep-use-after-donate``) — traced ``pjit``
+  equations donate every state leaf, and no caller reads a name it
+  donated (``clone_state`` is the escape hatch).
+
+Run: ``python -m tpu_gossip.analysis --deep`` (or ``--deep-only``).
+Findings flow through the same registry/baseline/CLI machinery as the
+AST rules. Docs: docs/static_analysis.md (deep-tier catalogue).
+"""
+
+from __future__ import annotations
+
+from tpu_gossip.analysis.registry import DEEP_RULES, Finding  # noqa: F401
+
+__all__ = ["run_deep", "DEEP_RULES"]
+
+
+def _scope_modules(root=None):
+    from tpu_gossip.analysis.cli import _DEFAULT_SCOPE, modules_for, repo_root
+
+    root = repo_root() if root is None else root
+    return modules_for(root, list(_DEFAULT_SCOPE))
+
+
+def run_deep(cache: dict | None = None, *, modules=None,
+             trace: bool = True) -> list[Finding]:
+    """All deep passes; returns sorted findings.
+
+    ``cache`` (name -> TracedEntry) shares entry-point traces with the
+    contract audit in the same invocation. ``modules`` overrides the
+    AST-side scope (fixture runs); ``trace=False`` skips the jaxpr passes
+    entirely (explicit-path CLI runs lint sources only, the same reason
+    the contract audit skips there).
+    """
+    from tpu_gossip.analysis.deep.donation import (
+        donation_ast_findings,
+        donation_jaxpr_findings,
+    )
+    from tpu_gossip.analysis.deep.lineage import lineage_findings
+    from tpu_gossip.analysis.deep.reductions import reduction_findings
+
+    findings: list[Finding] = []
+    if trace:
+        from tpu_gossip.analysis.entrypoints import entry_points, trace_matrix
+
+        traced = trace_matrix(entry_points(), cache=cache)
+        for name, te in traced.items():
+            if te.error is not None:
+                findings.append(Finding(
+                    file=f"<trace:{name}>", line=0, col=0,
+                    rule="deep-trace-error",
+                    message=f"entry point failed to trace: {te.error}",
+                    hint="the deep passes need a traceable round — fix "
+                    "the entry point (the contract audit reports the same "
+                    "break)",
+                    qualname=name,
+                ))
+        findings.extend(lineage_findings(traced))
+        findings.extend(reduction_findings(traced))
+        findings.extend(donation_jaxpr_findings(traced))
+    findings.extend(
+        donation_ast_findings(
+            _scope_modules() if modules is None else modules
+        )
+    )
+    findings.sort(key=lambda f: f.sort_key)
+    return findings

@@ -1,0 +1,505 @@
+"""The shared entry-point matrix: one harness, two consumers.
+
+Both dynamic analysis tiers walk the SAME matrix of public round entry
+points — the eval_shape contract audit (contracts.py, shape/dtype
+fixed-point checks) and the jaxpr deep tier (deep/, dataflow passes over
+the traced equations). The matrix is the product the repo's bit-identity
+contract quantifies over: 3 local delivery engines × modes × msg_slots ×
+churn/SIR/compact × every protocol-tail implementation × chaos scenarios
+× growth schedules × both mesh engines × sparse transport, plus the
+jitted loop entries (``simulate``/``run_until_coverage`` and their dist
+twins). A new engine or mode added here is traced by BOTH tiers; a
+matrix entry added to one tier only cannot exist
+(tests/analysis/test_entrypoints.py pins the shared parametrization).
+
+Each :class:`EntryPoint` resolves its callable through the owning module
+AT TRACE TIME (``engine.gossip_round``, never a captured reference) so
+tests can monkeypatch a deliberate break and assert both tiers report it.
+:func:`trace_matrix` runs ``jax.make_jaxpr(..., return_shape=True)``
+once per entry and hands the audit its output specs and the deep tier its
+jaxpr from the SAME trace — callers sharing a ``cache`` dict (the CLI)
+pay the matrix once per invocation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+__all__ = [
+    "EntryPoint",
+    "TracedEntry",
+    "entry_points",
+    "trace_matrix",
+    "dist_guard",
+]
+
+_N_MATCH = 256  # tiny matching build (compile cost: seconds, CPU)
+_N_DEV = 512  # tiny device-CSR build
+_MSG_SLOTS = (1, 16)  # one word group / multi-slot packed group
+_MODES = ("push", "push_pull", "flood")
+_SIM_ROUNDS = 3  # simulate's stacked-stats leading dim
+_DIST_SIM_ROUNDS = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One traceable public entry point of the round machinery.
+
+    ``build()`` returns ``(fn, state)`` with ``fn(state)`` traceable and
+    every non-state operand closed over; ``fn`` must resolve the target
+    through its module at call time. ``audit_check`` names the contract
+    check that owns this entry — the union over checks must cover the
+    whole matrix (test-pinned), so the audit can't silently skip an entry
+    the deep tier traces (or vice versa).
+    """
+
+    name: str
+    engine: str  # xla | pallas | matching | dist-matching | dist-bucketed
+    kind: str  # round | simulate | coverage
+    audit_check: str
+    build: Callable[[], Tuple[Callable, Any]]
+    stats_leading: tuple | None = ()  # None: entry returns no stats
+    has_ici: bool = False
+    jit_name: str | None = None  # jitted+donating entries: pjit name param
+
+
+@dataclasses.dataclass
+class TracedEntry:
+    """One entry's trace: jaxpr + output shape pytree, or the error."""
+
+    ep: EntryPoint
+    state: Any = None
+    jaxpr: Any = None  # jax.core.ClosedJaxpr
+    out_shape: Any = None  # pytree of jax.ShapeDtypeStruct
+    error: str | None = None
+
+
+@functools.lru_cache(maxsize=None)
+def _ctx():
+    """Tiny concrete graphs/plans/states shared by all entries (built once)."""
+    import jax
+    import numpy as np
+
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.matching_topology import matching_powerlaw_graph
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.kernels.pallas_segment import build_staircase_plan
+
+    dg = device_powerlaw_graph(_N_DEV, gamma=2.5, key=jax.random.key(0))
+    mg, mplan = matching_powerlaw_graph(
+        _N_MATCH, gamma=2.5, fanout=1, key=jax.random.key(0), export_csr=True
+    )
+    splan = build_staircase_plan(
+        np.asarray(dg.row_ptr), np.asarray(dg.col_idx), fanout=1
+    )
+
+    def state_for(graph, m: int, **cfg_kw):
+        cfg = SwarmConfig(
+            n_peers=graph.n_pad, msg_slots=m, fanout=1, **cfg_kw
+        )
+        st = init_swarm(
+            graph.as_padded_graph(), cfg, origins=[0], exists=graph.exists,
+            key=jax.random.key(0),
+        )
+        return st, cfg
+
+    return {
+        "dg": dg, "mg": mg, "mplan": mplan, "splan": splan,
+        "state_for": state_for,
+    }
+
+
+def _chaos_scenario(n_slots: int, n_real: int):
+    """A non-trivial compiled scenario — every fault class active (loss,
+    delay, partition, blackout, churn burst) — so the scenario-threaded
+    round traces its full structure (two-pass delivery, held buffer,
+    burst churn) under the fixed-point contract."""
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+
+    spec = scenario_from_dict({
+        "name": "audit-chaos",
+        "phases": [
+            {"name": "lossy", "start": 0, "end": 2, "loss": 0.2,
+             "delay": 0.2},
+            {"name": "split", "start": 2, "end": 4, "partition": "half"},
+            {"name": "storm", "start": 4, "end": 6, "churn_leave": 0.05,
+             "churn_join": 0.2, "blackout": {"frac": 0.1, "seed": 1}},
+        ],
+    })
+    return compile_scenario(
+        spec, n_peers=n_real, n_slots=n_slots, total_rounds=8
+    )
+
+
+def _growth_plan(n_slots: int, n_initial: int):
+    """A small compiled growth schedule so the growing round traces its
+    full structure (admission slice, Gumbel-top-k draw, registry
+    scatters) under the fixed-point contract — pinning the growth plane
+    exactly the way the chaos scenario pins ``fault_held``."""
+    import numpy as np
+
+    from tpu_gossip.growth import compile_growth
+
+    target = min(n_initial + 32, n_slots)
+    return compile_growth(
+        n_initial=n_initial,
+        target=target,
+        n_slots=n_slots,
+        joins_per_round=4,
+        attach_m=2,
+        admit_rows=np.arange(n_initial, target),
+        max_join_burst=4,
+    )
+
+
+def dist_guard() -> str | None:
+    """None when the host mesh can verify the dist contracts, else why not."""
+    from tpu_gossip import dist as dist_pkg
+
+    mesh = dist_pkg.make_mesh()
+    if 128 % mesh.size:
+        return (
+            f"mesh size {mesh.size} does not divide 128 — dist contracts "
+            "unverifiable on this host (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def _dist_ctx():
+    """Mesh, sharded graphs/plans/states shared by the dist entries."""
+    import jax
+    import numpy as np
+
+    from tpu_gossip import dist as dist_pkg
+    from tpu_gossip.core import matching_topology as mt
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.core.topology import (
+        build_csr, configuration_model, powerlaw_degree_sequence,
+    )
+    from tpu_gossip.dist import mesh as mesh_mod
+
+    mesh = dist_pkg.make_mesh()
+    g, plan = mt.matching_powerlaw_graph_sharded(
+        _N_MATCH, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+
+    def m_state(**cfg_kw):
+        cfg = SwarmConfig(
+            n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull", **cfg_kw
+        )
+        st = init_swarm(
+            g.as_padded_graph(), cfg, origins=[0], exists=g.exists,
+            key=jax.random.key(0),
+        )
+        return st, cfg
+
+    rng = np.random.default_rng(0)
+    graph = build_csr(
+        _N_DEV,
+        configuration_model(
+            powerlaw_degree_sequence(_N_DEV, gamma=2.5, rng=rng), rng=rng
+        ),
+    )
+    sg, relabeled, position = mesh_mod.partition_graph(graph, mesh.size, seed=0)
+
+    def b_state(**cfg_kw):
+        cfg = SwarmConfig(
+            n_peers=sg.n_pad, msg_slots=16, fanout=1, mode="push_pull",
+            **cfg_kw,
+        )
+        st = mesh_mod.init_sharded_swarm(
+            sg, relabeled, position, cfg, origins=[0]
+        )
+        return st, cfg
+
+    return {
+        "mesh": mesh, "g": g, "plan": plan, "sg": sg, "m_state": m_state,
+        "b_state": b_state,
+    }
+
+
+def _local_entries() -> list[EntryPoint]:
+    from tpu_gossip.sim import engine  # resolved through the module below
+
+    ctx = _ctx()
+    eps: list[EntryPoint] = []
+    engines = (
+        ("xla", ctx["dg"], None),
+        ("pallas", ctx["dg"], ctx["splan"]),
+        ("matching", ctx["mg"], ctx["mplan"]),
+    )
+
+    def round_ep(name, eng, graph, m, plan, cfg_kw, round_kw):
+        def build(graph=graph, m=m, plan=plan, cfg_kw=cfg_kw,
+                  round_kw=round_kw):
+            st, cfg = ctx["state_for"](graph, m, **cfg_kw)
+            return (
+                lambda s: engine.gossip_round(s, cfg, plan, **round_kw),
+                st,
+            )
+
+        return EntryPoint(
+            name=name, engine=eng, kind="round",
+            audit_check="gossip_round_local", build=build,
+        )
+
+    for m in _MSG_SLOTS:
+        for mode in _MODES:
+            for eng, graph, plan in engines:
+                eps.append(round_ep(
+                    f"local[{eng},{mode},m={m}]", eng, graph, m, plan,
+                    dict(mode=mode), {},
+                ))
+    # churn + SIR shapes ride the same fixed-point contract
+    churn = dict(
+        churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+    )
+    eps.append(round_ep(
+        "local[xla,churn]", "xla", ctx["dg"], 16, None,
+        dict(mode="push_pull", **churn), {},
+    ))
+    eps.append(round_ep(
+        "local[xla,sir]", "xla", ctx["dg"], 16, None,
+        dict(mode="push_pull", sir_recover_rounds=8), {},
+    ))
+    eps.append(round_ep(
+        "local[xla,churn-compact]", "xla", ctx["dg"], 16, None,
+        dict(mode="push_pull", rewire_compact_cap=64, **churn), {},
+    ))
+    # every tail implementation (kernels/round_tail.py) must keep the round
+    # a state fixed point — the rail that makes aggressive fusion safe: a
+    # tail that drops, reshapes, or re-types a slot array cannot reach a
+    # scan/while_loop carry without failing here first. Churn + SIR ride
+    # along so the fresh-mask and recovery branches are traced too.
+    for tail in ("reference", "fused", "pallas"):
+        eps.append(round_ep(
+            f"local[xla,tail={tail}]", "xla", ctx["dg"], 16, None,
+            dict(mode="push_pull", sir_recover_rounds=4, **churn),
+            dict(tail=tail),
+        ))
+    # chaos scenarios (faults/): a round with every fault class active —
+    # two-pass partition delivery, the delay buffer, blackout masks, burst
+    # churn — must still be a state fixed point on every delivery engine,
+    # or a scenario could never ride a scan/while carry
+    for eng, graph, plan, n_real in (
+        ("xla", ctx["dg"], None, _N_DEV),
+        ("matching", ctx["mg"], ctx["mplan"], _N_MATCH),
+    ):
+        def build_scen(graph=graph, plan=plan, n_real=n_real):
+            st, cfg = ctx["state_for"](
+                graph, 16, mode="push_pull", rewire_slots=2,
+                churn_join_prob=0.02, churn_leave_prob=0.002,
+            )
+            sc = _chaos_scenario(graph.n_pad, n_real)
+            return (
+                lambda s: engine.gossip_round(s, cfg, plan, scenario=sc),
+                st,
+            )
+
+        eps.append(EntryPoint(
+            name=f"local[{eng},scenario]", engine=eng, kind="round",
+            audit_check="gossip_round_local", build=build_scen,
+        ))
+    # the GROWING round (growth/): admission slice + Gumbel-top-k +
+    # registry scatters must keep the round a state fixed point on every
+    # local delivery engine — a growth plane that reshapes or drops a
+    # registry leaf could never ride a scan/while carry or a checkpoint
+    for eng, graph, plan in engines:
+        def build_grow(graph=graph, plan=plan):
+            st, cfg = ctx["state_for"](
+                graph, 16, mode="push_pull", rewire_slots=2,
+            )
+            gp = _growth_plan(graph.n_pad, graph.n_pad - 40)
+            return (
+                lambda s: engine.gossip_round(s, cfg, plan, growth=gp),
+                st,
+            )
+
+        eps.append(EntryPoint(
+            name=f"local[{eng},growth]", engine=eng, kind="round",
+            audit_check="gossip_round_local", build=build_grow,
+        ))
+
+    # scenario + growth COMPOSED (join_burst phases ride the fault tables;
+    # both parallel streams fold in the same trace — the salt-collision
+    # surface the deep tier's lineage pass audits)
+    def build_both():
+        st, cfg = ctx["state_for"](
+            ctx["dg"], 16, mode="push_pull", rewire_slots=2,
+            churn_join_prob=0.02, churn_leave_prob=0.002,
+        )
+        sc = _chaos_scenario(ctx["dg"].n_pad, _N_DEV)
+        gp = _growth_plan(ctx["dg"].n_pad, ctx["dg"].n_pad - 40)
+        return (
+            lambda s: engine.gossip_round(s, cfg, scenario=sc, growth=gp),
+            st,
+        )
+
+    eps.append(EntryPoint(
+        name="local[xla,scenario+growth]", engine="xla", kind="round",
+        audit_check="gossip_round_local", build=build_both,
+    ))
+
+    # the jitted loop entries (donating: state aliases the carry)
+    def build_sim():
+        st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
+        return (lambda s: engine.simulate(s, cfg, _SIM_ROUNDS), st)
+
+    eps.append(EntryPoint(
+        name="local[simulate]", engine="xla", kind="simulate",
+        audit_check="simulate_and_coverage", build=build_sim,
+        stats_leading=(_SIM_ROUNDS,), jit_name="simulate",
+    ))
+
+    def build_cov():
+        st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
+        return (
+            lambda s: engine.run_until_coverage(s, cfg, 0.99, 10), st,
+        )
+
+    eps.append(EntryPoint(
+        name="local[run_until_coverage]", engine="xla", kind="coverage",
+        audit_check="simulate_and_coverage", build=build_cov,
+        stats_leading=None, jit_name="run_until_coverage",
+    ))
+    return eps
+
+
+def _dist_entries() -> list[EntryPoint]:
+    from tpu_gossip.dist import mesh as mesh_mod  # call-time resolution
+
+    dctx = _dist_ctx()
+    mesh, plan, sg = dctx["mesh"], dctx["plan"], dctx["sg"]
+    eps: list[EntryPoint] = []
+
+    def dist_ep(name, eng, audit_check, state_kw, round_kw, *,
+                kind="round", stats_leading=(), has_ici=False, jit_name=None):
+        mk_state = dctx["m_state"] if eng == "dist-matching" else dctx["b_state"]
+        graph_plan = plan if eng == "dist-matching" else sg
+
+        def build():
+            st, cfg = mk_state(**state_kw)
+            kw = dict(round_kw)
+            if "scenario" in kw and kw["scenario"] is True:
+                kw["scenario"] = _chaos_scenario(
+                    plan.n if eng == "dist-matching" else sg.n_pad,
+                    _N_MATCH if eng == "dist-matching" else _N_DEV,
+                )
+            if "growth" in kw and kw["growth"] is True:
+                n_slots = plan.n if eng == "dist-matching" else sg.n_pad
+                kw["growth"] = _growth_plan(n_slots, n_slots - 40)
+            if kw.pop("sparse", False):
+                from tpu_gossip.dist import transport as tp
+
+                kw["transport"] = tp.build_transport(graph_plan, mode="sparse")
+            if kind == "round":
+                fn = lambda s: mesh_mod.gossip_round_dist(  # noqa: E731
+                    s, cfg, graph_plan, mesh, **kw
+                )
+            elif kind == "simulate":
+                fn = lambda s: mesh_mod.simulate_dist(  # noqa: E731
+                    s, cfg, graph_plan, mesh, _DIST_SIM_ROUNDS, **kw
+                )
+            else:
+                fn = lambda s: mesh_mod.run_until_coverage_dist(  # noqa: E731
+                    s, cfg, graph_plan, mesh, 0.99, 6, **kw
+                )
+            return fn, st
+
+        return EntryPoint(
+            name=name, engine=eng, kind=kind, audit_check=audit_check,
+            build=build, stats_leading=stats_leading, has_ici=has_ici,
+            jit_name=jit_name,
+        )
+
+    eps.append(dist_ep(
+        "dist[matching]", "dist-matching", "gossip_round_dist", {}, {},
+    ))
+    # the mesh round under an active chaos scenario (faults/) — the
+    # bit-identity contract's distributed half must trace with the same
+    # fixed point the local scenario round keeps
+    eps.append(dist_ep(
+        "dist[matching,scenario]", "dist-matching", "gossip_round_dist",
+        {}, dict(scenario=True),
+    ))
+    # the GROWING mesh round — the membership half of the bit-identity
+    # contract must trace with the same state fixed point on the mesh
+    # (growth edges ride the re-wiring plane, so the config carries slots)
+    eps.append(dist_ep(
+        "dist[matching,growth]", "dist-matching", "gossip_round_dist",
+        dict(rewire_slots=2), dict(growth=True),
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed]", "dist-bucketed", "gossip_round_dist", {}, {},
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed,growth]", "dist-bucketed", "gossip_round_dist",
+        dict(rewire_slots=2), dict(growth=True),
+    ))
+    # the jitted dist loop entries (donating) — scan/while over shard_map
+    eps.append(dist_ep(
+        "dist[matching,simulate]", "dist-matching", "gossip_round_dist",
+        {}, {}, kind="simulate", stats_leading=(_DIST_SIM_ROUNDS,),
+        jit_name="simulate_dist",
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed,run_until_coverage]", "dist-bucketed",
+        "gossip_round_dist", {}, {}, kind="coverage", stats_leading=None,
+        jit_name="run_until_coverage_dist",
+    ))
+    # sparse transport: both engines under transport=sparse must stay a
+    # state fixed point with IciRound declared scalar int32
+    eps.append(dist_ep(
+        "dist[matching,sparse]", "dist-matching", "sparse_transport",
+        {}, dict(sparse=True, collect_ici=True), has_ici=True,
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed,sparse]", "dist-bucketed", "sparse_transport",
+        {}, dict(sparse=True, collect_ici=True), has_ici=True,
+    ))
+    return eps
+
+
+def entry_points() -> tuple[EntryPoint, ...]:
+    """The full matrix. Dist entries are omitted (with the reason left to
+    :func:`dist_guard`) on hosts whose device count cannot mesh 128."""
+    eps = _local_entries()
+    if dist_guard() is None:
+        eps.extend(_dist_entries())
+    return tuple(eps)
+
+
+def trace_matrix(
+    eps,
+    cache: Dict[str, TracedEntry] | None = None,
+) -> Dict[str, TracedEntry]:
+    """``jax.make_jaxpr(..., return_shape=True)`` over ``eps``.
+
+    Returns name -> :class:`TracedEntry`; a failed build/trace records its
+    error instead of raising (the consumer turns it into a finding). Pass
+    the same ``cache`` dict across consumers to trace each entry once per
+    invocation — tests pass none and get monkeypatch-fresh traces.
+    """
+    import jax
+
+    out: Dict[str, TracedEntry] = {}
+    for ep in eps:
+        if cache is not None and ep.name in cache:
+            out[ep.name] = cache[ep.name]
+            continue
+        te = TracedEntry(ep=ep)
+        try:
+            fn, st = ep.build()
+            te.state = st
+            te.jaxpr, te.out_shape = jax.make_jaxpr(fn, return_shape=True)(st)
+        except Exception as e:  # noqa: BLE001 — consumers report, not crash
+            te.error = f"{e!r:.300}"
+        out[ep.name] = te
+        if cache is not None:
+            cache[ep.name] = te
+    return out

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, Iterable
 
-__all__ = ["Finding", "RULES", "rule", "run_rules"]
+__all__ = ["Finding", "RULES", "DEEP_RULES", "rule", "run_rules"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,12 +35,37 @@ class Finding:
     rule: str
     message: str
     hint: str = ""
+    # enclosing function/check qualname — the stable identity anchor:
+    # messages may embed shapes/values that drift with unrelated edits,
+    # line numbers always do; (file, rule, qualname) survives both
+    qualname: str = ""
 
     @property
     def baseline_key(self) -> tuple[str, str, str]:
-        """Identity for baseline matching: line/col excluded so unrelated
-        edits above a finding don't resurrect it."""
+        """Identity for baseline matching: (file, rule, qualname) when the
+        finding carries a qualname, else (file, rule, message) — line/col
+        always excluded so unrelated edits above a finding don't resurrect
+        it, and message excluded whenever a stabler anchor exists."""
+        if self.qualname:
+            return (self.file, self.rule, self.qualname)
         return (self.file, self.rule, self.message)
+
+    @property
+    def baseline_keys(self) -> tuple[tuple[str, str, str], ...]:
+        """Every triple a baseline entry may match this finding under:
+        the preferred qualname identity plus the legacy (file, rule,
+        message) form — a baseline written by a pre-qualname tree must
+        keep suppressing after the rule starts attaching qualnames."""
+        if self.qualname:
+            return (self.baseline_key, (self.file, self.rule, self.message))
+        return (self.baseline_key,)
+
+    @property
+    def sort_key(self) -> tuple:
+        """Identity-stable ordering for machine-readable output: unrelated
+        edits that shift line numbers must not churn ``--format=json``
+        diffs or baseline files."""
+        return (self.file, self.rule, self.qualname, self.message, self.line)
 
     def render(self) -> str:
         loc = f"{self.file}:{self.line}:{self.col}" if self.line else self.file
@@ -54,6 +79,17 @@ class Finding:
 
 
 RULES: Dict[str, Callable] = {}
+
+# rule ids owned by the jaxpr deep tier (analysis/deep/) — not per-module
+# AST rules, so they never live in RULES, but pragmas may name them
+# (the AST-side use-after-donate honors pragmas) and the unknown-rule
+# check must not cry wolf on them
+DEEP_RULES = frozenset({
+    "deep-rng-lineage",
+    "deep-float-reduction",
+    "deep-use-after-donate",
+    "deep-trace-error",
+})
 
 
 def rule(rule_id: str):
@@ -104,7 +140,9 @@ def run_rules(module, only: Iterable[str] | None = None) -> list[Finding]:
                     "is deliberate>`",
                 )
             )
-        unknown = prag.rules - set(RULES) - {"*", "pragma-needs-reason"}
+        unknown = (
+            prag.rules - set(RULES) - DEEP_RULES - {"*", "pragma-needs-reason"}
+        )
         if unknown:
             findings.append(
                 Finding(

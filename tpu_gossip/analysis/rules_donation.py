@@ -90,6 +90,7 @@ def _finding(module: ModuleInfo, node: ast.AST, fname: str) -> Finding:
         hint="add donate_argnames=(\"state\",) and make callers thread the "
         "result or pass core.state.clone_state(state); a deliberate "
         "non-donating entry point takes a pragma with its reason",
+        qualname=fname,
     )
 
 

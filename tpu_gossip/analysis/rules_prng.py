@@ -142,6 +142,7 @@ class _FnChecker:
                 hint="derive fresh keys with jax.random.split/fold_in before "
                 "each consumer; reuse silently correlates draws and voids "
                 "the local<->sharded bit-identity contract",
+                qualname=self._fname(),
             )
         )
 
@@ -183,6 +184,7 @@ class _FnChecker:
                                         hint="thread a split product of the "
                                         "caller's key instead of a constant "
                                         "stream",
+                                        qualname=self._fname(),
                                     )
                                 )
             else:

@@ -12,9 +12,10 @@ calls, nested defs, function-valued arguments).
 
 Static-cast exemption: ``int(...)``/``float(...)`` over trace-time
 constants is idiomatic and allowed — arguments mentioning ``.shape``,
-``.ndim``, ``.size``, ``.dtype``, ``len(...)``, literals, or plain
-arithmetic thereof stay clean (``sim/engine.py`` sizes capacity tables
-this way).
+``.ndim``, ``.size``, ``.dtype``, ``.itemsize``, ``len(...)``, literals,
+or plain arithmetic thereof stay clean (``sim/engine.py`` sizes capacity
+tables this way; the deep tier's jaxpr helpers size byte budgets off
+``.itemsize`` without needing pragmas).
 
 File allowlist: ``core/topology.py`` and ``core/matching_topology.py``
 keep deliberate host-side build paths (numpy graph planning that runs once
@@ -60,14 +61,19 @@ def set_project(project: Project | None) -> None:
     _PROJECT = project
 
 
-def _is_static_expr(node: ast.AST) -> bool:
+def _is_static_expr(
+    node: ast.AST, static_names: frozenset[str] | set[str] = frozenset()
+) -> bool:
     """True when an int()/float() argument is clearly trace-time static."""
     if isinstance(node, ast.Constant):
         return True
     for sub in ast.walk(node):
         if isinstance(sub, ast.Attribute) and sub.attr in (
-            "shape", "ndim", "size", "dtype", "n", "rows", "n_peers",
+            "shape", "ndim", "size", "dtype", "itemsize",
+            "n", "rows", "n_peers",
         ):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in static_names:
             return True
         if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and (
             sub.func.id == "len"
@@ -105,8 +111,69 @@ def _static_param_names(module: ModuleInfo, fn: ast.AST) -> set[str]:
     return names
 
 
+def _static_local_names(fn: ast.AST, seed: set[str]) -> set[str]:
+    """Locals bound from clearly-static expressions — ``rank = int(x.ndim)``
+    then ``float(rank * width)`` is as static as the inline spelling.
+    Fixpoint over simple single-target assignments; a name ALSO bound from
+    a non-static value anywhere in the function — including as a
+    non-static PARAMETER, which is a traced binding of that name — is
+    dropped (conservative: ambiguity flags rather than exempts)."""
+    assigns: list[tuple[str, ast.AST]] = []
+    for node in _walk_own(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            assigns.append((node.targets[0].id, node.value))
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and node.value is not None
+        ):
+            assigns.append((node.target.id, node.value))
+    # a parameter outside the static seed is a traced binding of its name:
+    # a later static rebind (`rank = int(x.ndim)`) must not exempt reads
+    # of the traced value before it — such names are banned outright
+    banned: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        params = [
+            a.arg
+            for a in (
+                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            )
+        ]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        if args.kwarg:
+            params.append(args.kwarg.arg)
+        banned = set(params) - seed
+    names = set(seed)
+    changed = True
+    while changed:
+        changed = False
+        for name, value in assigns:
+            if name not in names and name not in banned and (
+                _is_static_expr(value, names)
+            ):
+                names.add(name)
+                changed = True
+        # demotion must run INSIDE the fixpoint and ban re-entry: dropping
+        # an ambiguous name can make a derived name's expression non-static
+        # in turn (`b = y; c = b * 2; b = int(x.ndim)` — c is traced)
+        for name, value in assigns:
+            if name in names and name not in seed and (
+                not _is_static_expr(value, names)
+            ):
+                names.discard(name)
+                banned.add(name)
+                changed = True
+    return names
+
+
 def _check_function(module: ModuleInfo, fn: ast.AST):
-    static_params = _static_param_names(module, fn)
+    static_params = _static_local_names(fn, _static_param_names(module, fn))
     for node in _walk_own(fn):
         if not isinstance(node, ast.Call):
             continue
@@ -130,16 +197,13 @@ def _check_function(module: ModuleInfo, fn: ast.AST):
                     ),
                     hint="hoist to the host-side caller, or thread the value "
                     "in as an argument / jax.random key",
+                    qualname=fname,
                 )
                 continue
             if (
                 dotted in _HOST_CASTS
                 and node.args
-                and not _is_static_expr(node.args[0])
-                and not (
-                    isinstance(node.args[0], ast.Name)
-                    and node.args[0].id in static_params
-                )
+                and not _is_static_expr(node.args[0], static_params)
             ):
                 yield Finding(
                     file=module.rel,
@@ -152,6 +216,7 @@ def _check_function(module: ModuleInfo, fn: ast.AST):
                     ),
                     hint="keep it an array (jnp.*), or compute from .shape/"
                     "len() if it is meant to be static",
+                    qualname=fname,
                 )
         if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
             # flagged regardless of the base expression: no module in this
@@ -170,6 +235,7 @@ def _check_function(module: ModuleInfo, fn: ast.AST):
                 ),
                 hint="keep the value on device; fetch scalars only "
                 "outside the jit boundary",
+                qualname=getattr(fn, "name", "<lambda>"),
             )
 
 

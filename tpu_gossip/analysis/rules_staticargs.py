@@ -92,6 +92,7 @@ def _check(module: ModuleInfo, kwargs, fn: ast.AST, fname: str):
                         ),
                         hint="rename the entry with the parameter — a stale "
                         "name silently demotes the argument to traced",
+                        qualname=fname,
                     )
         elif kw.arg == "static_argnums":
             nums = []
@@ -112,6 +113,7 @@ def _check(module: ModuleInfo, kwargs, fn: ast.AST, fname: str):
                             f"({n_positional} positional parameters)"
                         ),
                         hint="drop or renumber the stale index",
+                        qualname=fname,
                     )
 
 

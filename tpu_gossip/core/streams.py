@@ -1,0 +1,85 @@
+"""Canonical PRNG stream-salt registry: the one map of parallel streams.
+
+Every subsystem that needs randomness BESIDE the protocol's 5-way
+per-round split derives its stream as ``fold_in(state.rng, SALT)`` — a
+derivation parallel to the split, consumed independently, so a subsystem
+that is switched off (``scenario=None``, ``growth=None``) leaves the
+protocol trajectory bit-identical. That contract only holds while the
+salts are (a) unique — two subsystems folding the same salt would read
+the SAME stream and correlate draws the protocol treats as independent —
+and (b) clear of the split's child indices: ``fold_in(key, d)`` and
+``split(key, n)`` both index threefry counters off the same parent, so a
+small salt could alias a split child. This module is the single registry;
+uniqueness and the floor are asserted at import time, and the graftlint
+deep tier (analysis/deep/lineage.py) statically verifies every
+constant-salt ``fold_in`` reachable from a round entry point resolves to
+a registered salt.
+
+Adding a stream::
+
+    MY_STREAM_SALT = register_stream("my-subsystem", 0x<8 hex digits>)
+
+and document it in the stream-map tables of docs/fault_model.md and
+docs/growth_engine.md. The historical constants live here; their old
+homes (``faults.inject.FAULT_STREAM_SALT``,
+``growth.GROWTH_STREAM_SALT``) re-export for compatibility.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "STREAM_SALT_FLOOR",
+    "FAULT_STREAM_SALT",
+    "GROWTH_STREAM_SALT",
+    "register_stream",
+    "registered_salts",
+]
+
+# fold_in(key, d) and split(key, n) index threefry counters off the same
+# parent key; salts at or above this floor can never alias a split child
+# of any fan-out the codebase uses (the widest split is the protocol's
+# 5-way; 2**16 leaves four orders of magnitude of margin)
+STREAM_SALT_FLOOR = 0x10000
+
+_REGISTRY: dict[str, int] = {}
+
+
+def register_stream(name: str, salt: int) -> int:
+    """Register a named PRNG stream salt; returns ``salt``.
+
+    Raises at import time on a duplicate name, a colliding salt value, or
+    a salt below :data:`STREAM_SALT_FLOOR` — collisions must be
+    impossible to ship, not merely linted.
+    """
+    if not isinstance(salt, int) or not (STREAM_SALT_FLOOR <= salt < 2**63):
+        raise ValueError(
+            f"stream salt {name!r}={salt!r} outside "
+            f"[{STREAM_SALT_FLOOR:#x}, 2**63) — small salts can alias "
+            "split() children of the same parent key"
+        )
+    if name in _REGISTRY:
+        raise ValueError(f"stream name {name!r} already registered")
+    for other, s in _REGISTRY.items():
+        if s == salt:
+            raise ValueError(
+                f"stream salt collision: {name!r} and {other!r} both use "
+                f"{salt:#x} — the two subsystems would read the SAME "
+                "fold_in stream and correlate their draws"
+            )
+    _REGISTRY[name] = salt
+    return salt
+
+
+def registered_salts() -> dict[int, str]:
+    """salt -> stream name, for the deep tier's lineage pass."""
+    return {salt: name for name, salt in _REGISTRY.items()}
+
+
+# the canonical stream map (keep docs/fault_model.md + docs/growth_engine.md
+# tables in sync):
+#
+#   stream   salt         consumer                         draws
+#   fault    0x5CE7A510   faults/inject.py (scenarios)     loss/delay/blackout
+#   growth   0x9087A110   growth/engine.py (admission)     Gumbel-top-k targets
+FAULT_STREAM_SALT = register_stream("fault", 0x5CE7A510)
+GROWTH_STREAM_SALT = register_stream("growth", 0x9087A110)

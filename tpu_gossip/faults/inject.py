@@ -63,8 +63,10 @@ __all__ = [
 ]
 
 # folds the round's root key (state.rng) into the fault stream — a
-# derivation parallel to the protocol's 5-way split, never overlapping it
-FAULT_STREAM_SALT = 0x5CE7A510
+# derivation parallel to the protocol's 5-way split, never overlapping it.
+# The value lives in the canonical stream registry (core/streams.py, where
+# uniqueness is asserted at import); re-exported here for compatibility.
+from tpu_gossip.core.streams import FAULT_STREAM_SALT  # noqa: E402
 
 
 class RoundFaults(NamedTuple):

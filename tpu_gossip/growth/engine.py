@@ -54,8 +54,10 @@ __all__ = [
 
 # folds the round's root key (state.rng) into the growth stream — a
 # derivation parallel to the protocol's 5-way split and the fault
-# stream's FAULT_STREAM_SALT (0x5CE7A510), overlapping neither
-GROWTH_STREAM_SALT = 0x9087A110
+# stream's FAULT_STREAM_SALT, overlapping neither. The value lives in the
+# canonical stream registry (core/streams.py, where uniqueness is
+# asserted at import); re-exported here for compatibility.
+from tpu_gossip.core.streams import GROWTH_STREAM_SALT  # noqa: E402
 
 
 def realized_degrees(
