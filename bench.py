@@ -421,6 +421,137 @@ def bench_grow(n_target: int, n0: int, joins_per_round: int = 256,
     }
 
 
+def _round_opt(x, nd: int = 2):
+    """round() that passes None through (empty percentile tracks)."""
+    return None if x is None else round(x, nd)
+
+
+def bench_stream(n: int, rates=(0.5, 1.5, 4.0), msg_slots: int = 32,
+                 ttl: int | None = None, measure_rounds: int = 96,
+                 reps: int = 1, target: float = 0.99):
+    """Streaming serving plane at headline scale (traffic/,
+    docs/streaming_plane.md): sustained Poisson injection on the 1M
+    swarm, measured over a SATURATION CURVE of >=3 injection rates.
+
+    Each rate runs one fixed-horizon loaded simulate (ttl rounds of
+    warmup dropped, ``measure_rounds`` measured) and reports the serving
+    metrics the ROADMAP's millions-of-users claim is priced by:
+    delivered msgs/sec (at the config's 5 s round), p50/p99
+    rounds-to-coverage PER MESSAGE, conflation rate under load, and the
+    delivered-vs-offered ratio — whose collapse past ``msg_slots/ttl``
+    msgs/round (the slot budget over the lease horizon) IS the
+    saturation point: ``saturation_rate_msgs_per_round`` records the
+    smallest tested rate where delivered falls below half of offered
+    (None when no tested rate collapses — an honest "not driven to
+    saturation", never max(rates)).
+    The loaded round is timed against the unloaded round on the same
+    state, so the streaming stage's marginal cost is explicit. One
+    compile serves every rate: ``max_inject`` is pinned to the largest
+    rate's batch shape, and the arrival rate rides a traced scalar.
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.state import SwarmConfig, clone_state, init_swarm
+    from tpu_gossip.sim import metrics as SM
+    from tpu_gossip.sim.engine import simulate
+    from tpu_gossip.traffic import (
+        compile_stream, default_max_inject, min_feasible_ttl,
+    )
+
+    dg = device_powerlaw_graph(n, gamma=2.5, key=jax.random.key(0))
+    cfg = SwarmConfig(
+        n_peers=dg.n_pad, msg_slots=msg_slots, fanout=2, mode="push_pull"
+    )
+    state = init_swarm(
+        dg.as_padded_graph(), cfg, exists=dg.exists, key=jax.random.key(0)
+    )
+    feasible = min_feasible_ttl(n, cfg.fanout)
+    if ttl is None:
+        ttl = int(1.5 * feasible)
+    origin_rows = np.flatnonzero(np.asarray(dg.exists))
+    horizon = ttl + measure_rounds
+    max_inject = default_max_inject(max(rates))
+
+    def stream_for(rate):
+        return compile_stream(
+            rate=rate, msg_slots=msg_slots, ttl=ttl,
+            origin_rows=origin_rows, max_inject=max_inject,
+        )
+
+    def timed(strm, rounds):
+        best, stats = float("inf"), None
+        for _ in range(max(reps, 1)):
+            rep = clone_state(state)  # outside the timer (donation contract)
+            t0 = _time.perf_counter()
+            fin, stats = simulate(rep, cfg, rounds, None, "fused", None,
+                                  None, strm)
+            float(fin.coverage(0))  # completion barrier
+            best = min(best, _time.perf_counter() - t0)
+        return best, stats
+
+    # warm both compiles on throwaway clones (simulate donates its state)
+    for s in (stream_for(rates[0]), None):
+        fin_w, _ = simulate(clone_state(state), cfg, horizon, None, "fused",
+                            None, None, s)
+        float(fin_w.coverage(0))
+    del fin_w
+    unloaded_wall, _ = timed(None, horizon)
+    ms_unloaded = unloaded_wall / horizon * 1000.0
+
+    curve = []
+    for rate in rates:
+        wall, stats = timed(stream_for(rate), horizon)
+        rep = SM.steady_state_report(
+            stats, target=target, round_seconds=cfg.round_seconds,
+            warmup_rounds=ttl,
+        )
+        ms_loaded = wall / horizon * 1000.0
+        curve.append({
+            "rate_msgs_per_round": rate,
+            "delivered_msgs_per_sec": rep["delivered_msgs_per_sec"],
+            "delivered_per_round": rep["delivered_per_round"],
+            "offered_per_round": rep["offered_per_round"],
+            "delivery_ratio": rep["delivery_ratio"],
+            "conflation_rate": rep["conflation_rate"],
+            "p50_rounds_to_coverage": _round_opt(
+                rep["rounds_to_coverage"]["p50"]
+            ),
+            "p99_rounds_to_coverage": _round_opt(
+                rep["rounds_to_coverage"]["p99"]
+            ),
+            "episodes_completed": rep["episodes_completed"],
+            "ms_per_round": round(ms_loaded, 4),
+            "stream_overhead_vs_unloaded": round(
+                ms_loaded / max(ms_unloaded, 1e-9), 3
+            ),
+        })
+    best = max(curve, key=lambda c: c["delivered_per_round"])
+    # the MEASURED saturation onset: the smallest tested rate where most
+    # offered traffic stops opening its own episode (delivered collapses
+    # below half of offered — conflation/suppression dominating). None =
+    # the curve never drove the plane past its knee, a statement the
+    # record should make honestly rather than reporting max(rates)
+    saturated = [
+        c["rate_msgs_per_round"] for c in curve
+        if c["delivered_per_round"] < 0.5 * c["offered_per_round"]
+    ]
+    return {
+        "n_peers": n, "msg_slots": msg_slots, "slot_ttl": ttl,
+        "mode": cfg.mode, "horizon_rounds": horizon,
+        "warmup_rounds_dropped": ttl, "coverage_target": target,
+        "slot_budget_msgs_per_round": round(msg_slots / ttl, 3),
+        "unloaded_ms_per_round": round(ms_unloaded, 4),
+        "curve": curve,
+        "saturation_rate_msgs_per_round": min(saturated) if saturated
+        else None,
+        "peak_delivered_msgs_per_sec": best["delivered_msgs_per_sec"],
+    }
+
+
 def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
                       remat_every: int = 16, plan=None,
                       rewire_compact_cap: int = 0):
@@ -941,7 +1072,8 @@ def main(argv: list[str] | None = None) -> int:
         """True (and records the skip) when the budget is too spent for
         ``section`` — the guard that keeps rc=0 with the headline printed."""
         frac = {"north_star_10m": 0.40, "dist_200k": 0.70,
-                "dist_1m": 0.78, "grow_1m": 0.84, "dist_10m": 0.88}[section]
+                "dist_1m": 0.78, "grow_1m": 0.82, "stream_1m": 0.86,
+                "dist_10m": 0.90}[section]
         if elapsed() <= budget_s * frac:
             return False
         out["sections_skipped"].append(
@@ -1223,6 +1355,13 @@ def main(argv: list[str] | None = None) -> int:
             # tail's γ — the membership plane's headline numbers
             out["grow_1m"] = bench_grow(1_000_000, 950_000, reps=reps)
             flush_detail()
+        if not quick and not skip("stream_1m"):
+            # the streaming serving plane at 1M: sustained injection over
+            # a >=3-rate saturation curve — delivered msgs/sec, p50/p99
+            # rounds-to-coverage per message, conflation under load, and
+            # the loaded round's marginal cost (docs/streaming_plane.md)
+            out["stream_1m"] = bench_stream(1_000_000, reps=reps)
+            flush_detail()
         if not quick and not skip("dist_10m"):
             # north-star scale on the mesh: matching only (partition_graph
             # buckets a 10M CSR host-side — minutes of numpy — while the
@@ -1315,6 +1454,16 @@ def _compact(out: dict) -> dict:
             "ms_per_round_fixed": g["fixed_n"]["ms_per_round"],
             "admission_overhead": g["admission_overhead_vs_fixed"],
             "grown_degree_gamma": g["grown_degree_gamma"],
+        }
+    s = out.get("stream_1m")
+    if s:
+        compact["stream_1m"] = {
+            "peak_delivered_msgs_per_sec": s["peak_delivered_msgs_per_sec"],
+            "saturation_rate": s["saturation_rate_msgs_per_round"],
+            "p99_rounds_to_coverage": [
+                c["p99_rounds_to_coverage"] for c in s["curve"]
+            ],
+            "delivery_ratio": [c["delivery_ratio"] for c in s["curve"]],
         }
     if out.get("sections_skipped"):
         compact["sections_skipped"] = [

@@ -86,6 +86,50 @@ def test_broken_growth_registry_detected(monkeypatch):
     assert all("growth" in f.message for f in findings)
 
 
+def test_broken_stream_lease_detected(monkeypatch):
+    """Re-type the slot-lease table under an active stream: the loaded
+    round's fixed-point check must report it — the streaming plane's
+    state field is pinned the way fault_held and the growth registry are
+    (a drifted lease could never ride a scan carry or a checkpoint)."""
+    from tpu_gossip.sim import engine
+
+    orig = engine.gossip_round
+
+    def broken(state, cfg, plan=None, **kw):
+        import dataclasses
+
+        st, stats = orig(state, cfg, plan, **kw)
+        if kw.get("stream") is not None:
+            st = dataclasses.replace(
+                st, slot_lease=st.slot_lease.astype("int16")
+            )
+        return st, stats
+
+    monkeypatch.setattr(engine, "gossip_round", broken)
+    findings = audit_contracts(names=["gossip_round_local"])
+    assert findings, "audit missed a deliberate slot-lease break"
+    assert all("stream" in f.message for f in findings)
+
+
+def test_broken_stream_stats_detected(monkeypatch):
+    """Flatten the per-slot observability vector to a scalar: the stats
+    contract declares slot_infected/slot_age as (M,) int32 — the
+    steady-state report reconstructs per-message latency from them, so a
+    silent shape drift would corrupt every serving metric."""
+    from tpu_gossip.sim import engine
+
+    orig = engine.gossip_round
+
+    def broken(state, cfg, plan=None, **kw):
+        st, stats = orig(state, cfg, plan, **kw)
+        return st, stats._replace(slot_age=stats.slot_age.sum())
+
+    monkeypatch.setattr(engine, "gossip_round", broken)
+    findings = audit_contracts(names=["gossip_round_local"])
+    assert findings, "audit missed a deliberate slot_age shape break"
+    assert all("slot_age" in f.message for f in findings)
+
+
 def test_broken_occupancy_header_detected(monkeypatch):
     """Drift the occupancy header to float32: the sparse-transport check
     must report it against the declared header_spec (both the runtime

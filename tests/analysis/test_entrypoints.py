@@ -39,18 +39,20 @@ def test_matrix_structural_coverage():
             for m in (1, 16):
                 assert f"local[{eng},{mode},m={m}]" in names
     for extra in ("churn", "sir", "churn-compact", "scenario", "growth",
-                  "scenario+growth"):
+                  "stream", "scenario+growth", "scenario+growth+stream"):
         assert f"local[xla,{extra}]" in names
     for tail in ("reference", "fused", "pallas"):
         assert f"local[xla,tail={tail}]" in names
     assert "local[matching,scenario]" in names
     assert "local[matching,growth]" in names and "local[pallas,growth]" in names
+    assert "local[matching,stream]" in names and "local[pallas,stream]" in names
     assert "local[simulate]" in names and "local[run_until_coverage]" in names
     # dist half (present on this 8-device test host)
     assert {"dist-matching", "dist-bucketed"} <= engines
     for n in (
         "dist[matching]", "dist[matching,scenario]", "dist[matching,growth]",
-        "dist[bucketed]", "dist[bucketed,growth]",
+        "dist[matching,stream]",
+        "dist[bucketed]", "dist[bucketed,growth]", "dist[bucketed,stream]",
         "dist[matching,simulate]", "dist[bucketed,run_until_coverage]",
         "dist[matching,sparse]", "dist[bucketed,sparse]",
     ):

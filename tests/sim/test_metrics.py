@@ -52,7 +52,14 @@ def test_jsonl_rows(setup):
         "round", "coverage", "msgs_sent", "n_infected", "n_alive", "n_declared_dead",
         "msgs_dropped", "msgs_held", "msgs_delivered",
         "n_members", "degree_gamma",
+        "stream_offered", "stream_injected", "stream_conflated",
+        "stream_expired", "slot_infected", "slot_age",
     }
+    # the streaming plane's per-slot tracks emit as JSON lists (one entry
+    # per dedup slot); scalars stay scalars — and an unloaded run's
+    # streaming counters read all-zero
+    assert rows[0]["slot_infected"] == [0] * cfg.msg_slots
+    assert rows[0]["stream_offered"] == 0
 
 
 def test_cli_fixed_horizon(capsys):

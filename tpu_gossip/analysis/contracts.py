@@ -91,31 +91,42 @@ def _diff_specs(name: str, got, want, problems: list) -> None:
             )
 
 
-def _stats_contract(stats, problems: list, leading=()) -> None:
+def _stats_contract(stats, problems: list, leading=(), msg_slots=None) -> None:
     import jax.numpy as jnp
 
     declared = {
-        "coverage": jnp.float32,
-        "msgs_sent": jnp.int32,
-        "n_infected": jnp.int32,
-        "n_alive": jnp.int32,
-        "n_declared_dead": jnp.int32,
-        "msgs_dropped": jnp.int32,
-        "msgs_held": jnp.int32,
-        "msgs_delivered": jnp.int32,
+        "coverage": (jnp.float32, ()),
+        "msgs_sent": (jnp.int32, ()),
+        "n_infected": (jnp.int32, ()),
+        "n_alive": (jnp.int32, ()),
+        "n_declared_dead": (jnp.int32, ()),
+        "msgs_dropped": (jnp.int32, ()),
+        "msgs_held": (jnp.int32, ()),
+        "msgs_delivered": (jnp.int32, ()),
         # membership / degree-evolution track (growth/)
-        "n_members": jnp.int32,
-        "degree_gamma": jnp.float32,
+        "n_members": (jnp.int32, ()),
+        "degree_gamma": (jnp.float32, ()),
+        # streaming serving track (traffic/): the injection counters are
+        # scalars; the per-slot observability vectors span the slot dim
+        # (sim.metrics.steady_state_report reconstructs per-message
+        # latencies from them)
+        "stream_offered": (jnp.int32, ()),
+        "stream_injected": (jnp.int32, ()),
+        "stream_conflated": (jnp.int32, ()),
+        "stream_expired": (jnp.int32, ()),
+        "slot_infected": (jnp.int32, (msg_slots,)),
+        "slot_age": (jnp.int32, (msg_slots,)),
     }
-    for field, dt in declared.items():
+    for field, (dt, trailing) in declared.items():
         leaf = getattr(stats, field, None)
         if leaf is None:
             problems.append(f"RoundStats lost field {field!r}")
             continue
-        if tuple(leaf.shape) != tuple(leading):
+        want = tuple(leading) + tuple(trailing)
+        if tuple(leaf.shape) != want:
             problems.append(
                 f"RoundStats.{field}: shape {tuple(leaf.shape)} != declared "
-                f"{tuple(leading)}"
+                f"{want}"
             )
         if leaf.dtype != dt:
             problems.append(
@@ -159,7 +170,8 @@ def _check_matrix_entries(check_name: str) -> list:
             out_st, out_stats = out
         _diff_specs(name, _spec_tree(out_st), _spec_tree(te.state), problems)
         if out_stats is not None:
-            _stats_contract(out_stats, problems, leading=ep.stats_leading)
+            _stats_contract(out_stats, problems, leading=ep.stats_leading,
+                            msg_slots=te.state.seen.shape[1])
         if ici is not None:
             _ici_contract(name, ici, problems)
     return problems

@@ -6,9 +6,9 @@ fixed-point checks) and the jaxpr deep tier (deep/, dataflow passes over
 the traced equations). The matrix is the product the repo's bit-identity
 contract quantifies over: 3 local delivery engines × modes × msg_slots ×
 churn/SIR/compact × every protocol-tail implementation × chaos scenarios
-× growth schedules × both mesh engines × sparse transport, plus the
-jitted loop entries (``simulate``/``run_until_coverage`` and their dist
-twins). A new engine or mode added here is traced by BOTH tiers; a
+× growth schedules × streaming workloads × both mesh engines × sparse
+transport, plus the jitted loop entries (``simulate``/
+``run_until_coverage`` and their dist twins). A new engine or mode added here is traced by BOTH tiers; a
 matrix entry added to one tier only cannot exist
 (tests/analysis/test_entrypoints.py pins the shared parametrization).
 
@@ -151,6 +151,26 @@ def _growth_plan(n_slots: int, n_initial: int):
         attach_m=2,
         admit_rows=np.arange(n_initial, target),
         max_join_burst=4,
+    )
+
+
+def _stream_plan(msg_slots: int, exists, *, k_hashes: int = 2):
+    """A small compiled streaming workload (traffic/) so the loaded round
+    traces its full structure — Poisson arrival draw, origin gather, the
+    sequential landing scan over the lease table, the expired-column mask
+    through the fused tail — under the fixed-point contract. Bursty
+    cadence + k>=2 Bloom landing exercise both static branches."""
+    import numpy as np
+
+    from tpu_gossip.traffic import compile_stream
+
+    return compile_stream(
+        rate=2.0,
+        msg_slots=msg_slots,
+        ttl=8,
+        origin_rows=np.flatnonzero(np.asarray(exists)),
+        k_hashes=min(k_hashes, msg_slots),
+        burst_every=4,
     )
 
 
@@ -325,6 +345,23 @@ def _local_entries() -> list[EntryPoint]:
             audit_check="gossip_round_local", build=build_grow,
         ))
 
+    # the LOADED round (traffic/): Poisson injection + lease age-out must
+    # keep the round a state fixed point on every local delivery engine —
+    # the slot_lease table rides scan/while carries and checkpoints
+    for eng, graph, plan in engines:
+        def build_stream(graph=graph, plan=plan):
+            st, cfg = ctx["state_for"](graph, 16, mode="push_pull")
+            sp = _stream_plan(16, graph.exists)
+            return (
+                lambda s: engine.gossip_round(s, cfg, plan, stream=sp),
+                st,
+            )
+
+        eps.append(EntryPoint(
+            name=f"local[{eng},stream]", engine=eng, kind="round",
+            audit_check="gossip_round_local", build=build_stream,
+        ))
+
     # scenario + growth COMPOSED (join_burst phases ride the fault tables;
     # both parallel streams fold in the same trace — the salt-collision
     # surface the deep tier's lineage pass audits)
@@ -343,6 +380,29 @@ def _local_entries() -> list[EntryPoint]:
     eps.append(EntryPoint(
         name="local[xla,scenario+growth]", engine="xla", kind="round",
         audit_check="gossip_round_local", build=build_both,
+    ))
+
+    # scenario + growth + stream FULLY COMPOSED — "flash crowd joins
+    # while a rack fails under full traffic" as one trace: THREE parallel
+    # fold_in streams beside the protocol's 5-way split, the maximal
+    # salt-collision surface the deep lineage pass audits
+    def build_all_three():
+        st, cfg = ctx["state_for"](
+            ctx["dg"], 16, mode="push_pull", rewire_slots=2,
+            churn_join_prob=0.02, churn_leave_prob=0.002,
+        )
+        sc = _chaos_scenario(ctx["dg"].n_pad, _N_DEV)
+        gp = _growth_plan(ctx["dg"].n_pad, ctx["dg"].n_pad - 40)
+        sp = _stream_plan(16, ctx["dg"].exists)
+        return (
+            lambda s: engine.gossip_round(s, cfg, scenario=sc, growth=gp,
+                                          stream=sp),
+            st,
+        )
+
+    eps.append(EntryPoint(
+        name="local[xla,scenario+growth+stream]", engine="xla", kind="round",
+        audit_check="gossip_round_local", build=build_all_three,
     ))
 
     # the jitted loop entries (donating: state aliases the carry)
@@ -397,6 +457,8 @@ def _dist_entries() -> list[EntryPoint]:
                 from tpu_gossip.dist import transport as tp
 
                 kw["transport"] = tp.build_transport(graph_plan, mode="sparse")
+            if kw.pop("stream", False):
+                kw["stream"] = _stream_plan(16, st.exists)
             if kind == "round":
                 fn = lambda s: mesh_mod.gossip_round_dist(  # noqa: E731
                     s, cfg, graph_plan, mesh, **kw
@@ -434,12 +496,24 @@ def _dist_entries() -> list[EntryPoint]:
         "dist[matching,growth]", "dist-matching", "gossip_round_dist",
         dict(rewire_slots=2), dict(growth=True),
     ))
+    # the LOADED mesh round (traffic/) — streaming injection draws at
+    # global shape outside shard_map must keep the mesh round a state
+    # fixed point on both engine families (the serving half of the
+    # bit-identity contract)
+    eps.append(dist_ep(
+        "dist[matching,stream]", "dist-matching", "gossip_round_dist",
+        {}, dict(stream=True),
+    ))
     eps.append(dist_ep(
         "dist[bucketed]", "dist-bucketed", "gossip_round_dist", {}, {},
     ))
     eps.append(dist_ep(
         "dist[bucketed,growth]", "dist-bucketed", "gossip_round_dist",
         dict(rewire_slots=2), dict(growth=True),
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed,stream]", "dist-bucketed", "gossip_round_dist",
+        {}, dict(stream=True),
     ))
     # the jitted dist loop entries (donating) — scan/while over shard_map
     eps.append(dist_ep(

@@ -147,6 +147,48 @@ def build_parser() -> argparse.ArgumentParser:
         "growth schedule without a state rebuild",
     )
     p.add_argument(
+        "--stream", type=float, default=0.0, metavar="RATE",
+        help="streaming serving plane (tpu_gossip/traffic/, docs/"
+        "streaming_plane.md): inject a sustained message stream at RATE "
+        "Poisson arrivals per round, each message leasing dedup slot(s) "
+        "that age out after --slot-ttl rounds — the (N, M) bitmap "
+        "becomes a sliding window over live messages. Draws come from a "
+        "dedicated PRNG stream on every engine (local and sharded "
+        "loaded runs stay bit-identical; rate 0 = off). Needs a fixed "
+        "--rounds horizon; the summary JSON gains steady-state serving "
+        "metrics (delivered msgs/sec, p50/p99 rounds-to-coverage per "
+        "message, conflation rate)",
+    )
+    p.add_argument(
+        "--stream-origins", choices=["uniform", "degree", "hotspot"],
+        default="uniform", metavar="DIST",
+        help="origin law for injected messages: uniform over the initial "
+        "membership, degree (degree-proportional — heavy users are the "
+        "hubs), or hotspot (--stream-hot-frac of the lowest peer ids "
+        "originate --stream-hot-weight of the traffic)",
+    )
+    p.add_argument(
+        "--slot-ttl", type=int, default=0, metavar="R",
+        help="rounds a message holds its dedup slot(s) before the "
+        "age-out recycles them (default: 3x the feasible coverage "
+        "horizon). A TTL below the feasible horizon cannot deliver "
+        "anything and is rejected at parse time",
+    )
+    p.add_argument(
+        "--stream-hashes", type=int, default=1, metavar="K",
+        help="Bloom planes per message (core.state.message_slots "
+        "semantics): 1 = slot conflation, >=2 = k-hash Bloom dedup "
+        "(all-planes-leased arrivals are suppressed at ingestion)",
+    )
+    p.add_argument(
+        "--stream-burst-every", type=int, default=0, metavar="B",
+        help="bursty arrivals: every B-th round draws at RATE * "
+        "--stream-burst-mult (0 = pure Poisson)",
+    )
+    p.add_argument("--stream-burst-mult", type=float, default=4.0, metavar="X")
+    p.add_argument("--stream-hot-frac", type=float, default=0.01, metavar="F")
+    p.add_argument("--stream-hot-weight", type=float, default=0.9, metavar="W")
+    p.add_argument(
         "--scenario", type=str, default="", metavar="TOML",
         help="chaos scenario schedule (tpu_gossip/faults/, docs/"
         "fault_model.md): time-phased message loss, delivery delay, "
@@ -221,6 +263,10 @@ def main(argv: list[str] | None = None) -> int:
     grow_err = _validate_grow(args, spec)
     if grow_err:
         print(grow_err, file=sys.stderr)
+        return 2
+    stream_err = _validate_stream(args)
+    if stream_err:
+        print(stream_err, file=sys.stderr)
         return 2
     if args.profile_round > 0 and args.shard:
         print("--profile-round decomposes the LOCAL round (use "
@@ -337,16 +383,23 @@ def main(argv: list[str] | None = None) -> int:
 
     scen = _compile_cli_scenario(spec, args, n_slots=graph.n)
     grow = _compile_cli_growth(args, spec, n_slots=graph.n, mplan=mplan)
+    strm = _compile_cli_stream(
+        args,
+        np.flatnonzero(np.asarray(exists)) if exists is not None
+        else np.arange(graph.n),
+    )
     with trace(args.profile):
         if args.remat_every > 0:
-            summary, fin = _run_with_remat(args, cfg, state, scen, grow)
+            summary, fin = _run_with_remat(args, cfg, state, scen, grow, strm)
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
             fin, stats = simulate(state, cfg, args.rounds, plan, args.tail,
-                                  scen, grow)
+                                  scen, grow, strm)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
-            summary = _horizon_summary(args, stats, **_scenario_summary(spec, stats))
+            summary = _horizon_summary(args, stats,
+                                       **_scenario_summary(spec, stats),
+                                       **_stream_summary(args, cfg, stats))
         else:
             if scen is None and grow is None:
                 result, fin = M.bench_swarm(
@@ -411,6 +464,106 @@ def _validate_grow(args, spec):
                 "admission schedule would admit the wrong rows after the "
                 "first rebuild (local --remat-every composes fine)")
     return None
+
+
+def _validate_stream(args):
+    """Normalize + reject impossible --stream configs; returns an error
+    string (exit 2) or None. Mutates args: fills the TTL default so
+    every engine path reads one settled config — the streaming twin of
+    :func:`_validate_grow`."""
+    if args.stream == 0:
+        set_flags = [
+            name for name, dflt in (
+                ("--slot-ttl", args.slot_ttl == 0),
+                ("--stream-origins", args.stream_origins == "uniform"),
+                ("--stream-hashes", args.stream_hashes == 1),
+                ("--stream-burst-every", args.stream_burst_every == 0),
+            ) if not dflt
+        ]
+        if set_flags:
+            return (f"{set_flags[0]} shapes the streaming workload; add "
+                    "--stream RATE")
+        return None
+    from tpu_gossip.traffic import min_feasible_ttl
+
+    if args.stream < 0:
+        return f"--stream {args.stream} must be a non-negative arrival rate"
+    if args.profile_round > 0:
+        return ("--profile-round measures the unloaded round's stage "
+                "decomposition; drop --stream")
+    if args.rounds <= 0:
+        return ("--stream measures a steady state over a fixed horizon — "
+                "run-to-coverage stops on slot 0, which the age-out "
+                "recycles; pass --rounds R (R >> --slot-ttl)")
+    if args.shard and args.remat_every > 0:
+        return ("--stream cannot compose with --shard --remat-every: the "
+                "epoch re-partition permutes peers, so the compiled "
+                "origin tables would inject at the wrong rows after the "
+                "first rebuild (local --remat-every composes fine)")
+    if not (1 <= args.stream_hashes <= args.slots):
+        return (f"--stream-hashes {args.stream_hashes} outside "
+                f"[1, --slots {args.slots}] — the Bloom planes live in "
+                "the slot dimension")
+    if args.stream_burst_every < 0 or args.stream_burst_mult <= 0:
+        return "--stream-burst-every must be >= 0 and --stream-burst-mult > 0"
+    if not (0 < args.stream_hot_frac <= 1) or not (
+        0 <= args.stream_hot_weight <= 1
+    ):
+        return ("--stream-hot-frac must lie in (0, 1] and "
+                "--stream-hot-weight in [0, 1]")
+    feasible = min_feasible_ttl(args.peers, args.fanout, args.mode)
+    if args.slot_ttl == 0:
+        args.slot_ttl = 3 * feasible
+    if args.slot_ttl < feasible:
+        return (f"--slot-ttl {args.slot_ttl} is below the feasible "
+                f"coverage horizon (~{feasible} rounds for {args.peers} "
+                f"peers at fanout {args.fanout}): every message would be "
+                "recycled before it could possibly cover — raise the TTL "
+                "or the fanout")
+    return None
+
+
+def _compile_cli_stream(args, origin_rows):
+    """Compile the --stream workload for one engine's row layout —
+    ``origin_rows`` is the id-ordered table of initial-member state rows
+    (the same id→row hook the scenario/growth compilers take)."""
+    if args.stream <= 0:
+        return None
+    from tpu_gossip.traffic import compile_stream
+
+    return compile_stream(
+        rate=args.stream,
+        msg_slots=args.slots,
+        ttl=args.slot_ttl,
+        origin_rows=origin_rows,
+        origins=args.stream_origins,
+        k_hashes=args.stream_hashes,
+        hot_frac=args.stream_hot_frac,
+        hot_weight=args.stream_hot_weight,
+        burst_every=args.stream_burst_every,
+        burst_mult=args.stream_burst_mult,
+    )
+
+
+def _stream_summary(args, cfg, stats=None) -> dict:
+    """Summary-row streaming fields: the workload config plus, when
+    per-round stats exist, the steady-state serving report (one TTL of
+    warmup dropped so the report reads the loaded window, not the
+    ramp)."""
+    if args.stream <= 0:
+        return {}
+    out = {"stream": {
+        "rate": args.stream, "origins": args.stream_origins,
+        "slot_ttl": args.slot_ttl, "k_hashes": args.stream_hashes,
+    }}
+    if stats is not None:
+        from tpu_gossip.sim import metrics as M
+
+        out["stream"].update(M.steady_state_report(
+            stats, target=args.target, round_seconds=cfg.round_seconds,
+            warmup_rounds=min(args.slot_ttl, args.rounds // 2),
+        ))
+    return out
 
 
 def _rewire_slots(args) -> int:
@@ -567,7 +720,7 @@ def _main_profile_round(args, cfg, state, plan) -> int:
     return 0
 
 
-def _run_with_remat(args, cfg, state, scen=None, grow=None):
+def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
     The first re-materialization pads col_idx to the fixed capacity, so the
@@ -609,10 +762,10 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None):
 
     def run_segment(st, seg, plan):
         if args.rounds > 0:
-            return simulate(st, cfg, seg, plan, args.tail, scen, grow)
+            return simulate(st, cfg, seg, plan, args.tail, scen, grow, strm)
         return run_until_coverage(
             st, cfg, args.target, seg, plan=plan, tail=args.tail,
-            scenario=scen, growth=grow,
+            scenario=scen, growth=grow, stream=strm,
         ), None
 
     # warm EVERY shape the timed loop will see, on throwaway clones:
@@ -661,7 +814,9 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None):
         ))
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
-        return _horizon_summary(args, stats, **extra), state
+        return _horizon_summary(
+            args, stats, **extra, **_stream_summary(args, cfg, stats)
+        ), state
     rounds = int(state.round)
     summary = {
         "summary": True, "mode": args.mode, "n_peers": args.peers,
@@ -926,16 +1081,18 @@ def _main_shard_matching(args, rng, spec=None) -> int:
         n_shards=mesh.size,
     )
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
+    strm = _compile_cli_stream(args, to_rows(np.arange(args.peers)))
     with trace(args.profile):
         if args.rounds > 0:
             if transport is not None:
                 fin, (stats, ici) = simulate_dist(
                     state, cfg, plan, mesh, args.rounds, None, scen, grow,
-                    transport, True,
+                    transport, True, strm,
                 )
             else:
                 fin, stats = simulate_dist(state, cfg, plan, mesh,
-                                           args.rounds, None, scen, grow)
+                                           args.rounds, None, scen, grow,
+                                           stream=strm)
                 ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -943,6 +1100,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                 args, stats, devices=mesh.size,
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
+                **_stream_summary(args, cfg, stats),
             )
         else:
             # the timed region runs WITHOUT the analytic counter so the
@@ -1046,6 +1204,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
         args, spec, n_slots=sg.n_pad,
         node_map=lambda ids: position[np.asarray(ids)],
     )
+    strm = _compile_cli_stream(args, position[np.arange(args.peers)])
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
@@ -1057,11 +1216,11 @@ def _main_shard(args, graph, rng, spec=None) -> int:
             if transport is not None:
                 fin, (stats, ici) = simulate_dist(
                     state, cfg, sg, mesh, args.rounds, plans, scen, grow,
-                    transport, True,
+                    transport, True, strm,
                 )
             else:
                 fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
-                                           plans, scen, grow)
+                                           plans, scen, grow, stream=strm)
                 ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -1069,6 +1228,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 args, stats, devices=mesh.size,
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
+                **_stream_summary(args, cfg, stats),
             )
         else:
             # the shared timing harness (warmup, fetch barrier) with the

@@ -151,6 +151,20 @@ class SwarmState:
     join_round: jax.Array  # int32 (N,) — round the slot joined (-1: never)
     admitted_by: jax.Array  # int32 (N,) — admitting-seed row id (-1: bootstrap member)
     degree_credit: jax.Array  # int32 (N,) — unfolded fresh in-edges (+1 each)
+    # streaming serving plane (traffic/): the slot-lease table that turns
+    # the (N, M) dedup bitmap into a SLIDING WINDOW over live messages.
+    # ``slot_lease[m]`` is the round the slot's current message was
+    # injected (-1 = free); the streaming stage of ``advance_round``
+    # recycles a slot ``ttl`` rounds after its lease (the fused round tail
+    # clears its column across every slot array) and the injection stage
+    # re-leases it to fresh traffic. Like ``fault_held`` this is the
+    # checkpointable STREAM CURSOR: together with ``rng``/``round`` a
+    # mid-stream checkpoint resumes bit-exactly under the same compiled
+    # stream. The no-stream round path carries the table UNTOUCHED (a
+    # fixed single-epidemic run never pays for it); checkpoints that
+    # predate the field load with every slot free except those
+    # ``init_swarm`` seeded (docs/streaming_plane.md).
+    slot_lease: jax.Array  # int32 (M,)
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -198,7 +212,11 @@ def load_swarm(path) -> SwarmState:
     engine lack the registry plane (``join_round``/``admitted_by``/
     ``degree_credit``); they load with it zeroed — every existing row a
     bootstrap member, capacity == n, exactly their semantics when
-    saved."""
+    saved. Checkpoints that predate the streaming plane lack
+    ``slot_lease``; they load with every occupied slot leased at round 0
+    and the rest free (``init_swarm``'s convention) — attaching a stream
+    to such a checkpoint treats the old epidemics as round-0 injections
+    (docs/streaming_plane.md has the age-out consequence)."""
     data = np.load(path)
     kwargs = {}
     _GROWTH_FIELDS = ("join_round", "admitted_by", "degree_credit")
@@ -207,15 +225,18 @@ def load_swarm(path) -> SwarmState:
             if f"prngkey_{f.name}" in data:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
             elif (
-                f.name == "fault_held" or f.name in _GROWTH_FIELDS
+                f.name in ("fault_held", "slot_lease")
+                or f.name in _GROWTH_FIELDS
             ) and f"field_{f.name}" not in data:
-                continue  # pre-scenario / pre-growth checkpoint: filled below
+                continue  # pre-scenario/growth/stream checkpoint: filled below
             else:
                 kwargs[f.name] = jnp.asarray(data[f"field_{f.name}"])
         if "fault_held" not in kwargs:
             kwargs["fault_held"] = jnp.zeros(kwargs["seen"].shape, dtype=bool)
         if "join_round" not in kwargs:
             kwargs.update(_zero_registry(kwargs["exists"]))
+        if "slot_lease" not in kwargs:
+            kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
     else:  # legacy positional layout
         for i, name in enumerate(_V1_FIELDS):
             if f"key_{i}" in data:
@@ -239,7 +260,18 @@ def load_swarm(path) -> SwarmState:
         kwargs["rewire_targets"] = jnp.zeros((n, 1), dtype=jnp.int32)
         kwargs["fault_held"] = jnp.zeros((n, m), dtype=bool)
         kwargs.update(_zero_registry(kwargs["exists"]))
+        kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
     return SwarmState(**kwargs)
+
+
+def _implied_leases(seen: jax.Array) -> jax.Array:
+    """The slot-lease table a pre-streaming checkpoint implies: any slot
+    carrying bits holds a message injected "at round 0" (the only round
+    such a checkpoint could have seeded it — ``init_swarm``'s convention);
+    empty slots are free. Streams attached on resume see the old epidemics
+    as aged round-0 leases, so a TTL shorter than the checkpoint's round
+    recycles them promptly instead of conflating new traffic into them."""
+    return jnp.where(jnp.any(seen, axis=0), 0, -1).astype(jnp.int32)
 
 
 def _zero_registry(exists: jax.Array) -> dict:
@@ -349,6 +381,7 @@ def init_swarm(
     n, m = config.n_peers, config.msg_slots
     seen = jnp.zeros((n, m), dtype=bool)
     infected_round = jnp.full((n, m), -1, dtype=jnp.int32)
+    slot_lease = jnp.full((m,), -1, dtype=jnp.int32)
     if origins is not None:
         origins = jnp.asarray(origins)
         if origin_slots is not None:
@@ -368,6 +401,10 @@ def init_swarm(
             slots = jnp.full(origins.shape, origin_slot)
         seen = seen.at[origins, slots].set(True)
         infected_round = infected_round.at[origins, slots].set(0)
+        # seeded slots hold round-0 "messages": under a streaming run
+        # (traffic/) their lease ages out like any injected message's;
+        # without one the table is carried untouched
+        slot_lease = slot_lease.at[slots].set(0)
     if exists is None:
         exists = jnp.ones((n,), dtype=bool)
 
@@ -405,6 +442,7 @@ def init_swarm(
         join_round=jnp.where(exists, 0, -1).astype(jnp.int32),
         admitted_by=jnp.full((n,), -1, dtype=jnp.int32),
         degree_credit=jnp.zeros((n,), dtype=jnp.int32),
+        slot_lease=slot_lease,
         rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

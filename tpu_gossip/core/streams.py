@@ -31,6 +31,7 @@ __all__ = [
     "STREAM_SALT_FLOOR",
     "FAULT_STREAM_SALT",
     "GROWTH_STREAM_SALT",
+    "TRAFFIC_STREAM_SALT",
     "register_stream",
     "registered_salts",
 ]
@@ -76,10 +77,12 @@ def registered_salts() -> dict[int, str]:
 
 
 # the canonical stream map (keep docs/fault_model.md + docs/growth_engine.md
-# tables in sync):
+# + docs/streaming_plane.md tables in sync):
 #
 #   stream   salt         consumer                         draws
 #   fault    0x5CE7A510   faults/inject.py (scenarios)     loss/delay/blackout
 #   growth   0x9087A110   growth/engine.py (admission)     Gumbel-top-k targets
+#   traffic  0x7AFF1C00   traffic/engine.py (injection)    arrivals/origins/slots
 FAULT_STREAM_SALT = register_stream("fault", 0x5CE7A510)
 GROWTH_STREAM_SALT = register_stream("growth", 0x9087A110)
+TRAFFIC_STREAM_SALT = register_stream("traffic", 0x7AFF1C00)

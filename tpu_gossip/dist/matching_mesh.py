@@ -472,6 +472,7 @@ def gossip_round_dist_matching(
     growth=None,
     transport=None,
     collect_ici: bool = False,
+    stream=None,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -487,6 +488,9 @@ def gossip_round_dist_matching(
     (growth/) admissions run in the shared ``advance_round`` at global
     shape too, so a GROWING mesh round stays bit-identical to its local
     twin — the membership extension of this engine's parity contract.
+    ``stream`` (traffic/) injects the streaming workload the same way —
+    a LOADED mesh round stays bit-identical to its local twin, the
+    serving extension of the contract (tests/sim/test_traffic.py).
     """
     from tpu_gossip.sim.engine import (
         advance_round,
@@ -525,7 +529,7 @@ def gossip_round_dist_matching(
         )
         out = advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, growth=growth,
+            k_join, receptive, growth=growth, stream=stream,
         )
         if not collect_ici:
             return out
@@ -545,7 +549,7 @@ def gossip_round_dist_matching(
     out = advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem, growth=growth,
+        fault_held=held, fstats=telem, growth=growth, stream=stream,
     )
     if not collect_ici:
         return out

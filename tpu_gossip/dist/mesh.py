@@ -822,6 +822,7 @@ def gossip_round_dist(
     growth=None,
     transport=None,
     collect_ici: bool = False,
+    stream=None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -848,7 +849,10 @@ def gossip_round_dist(
     ``transport=sparse`` (tests/sim/test_sparse_transport.py).
     ``collect_ici`` (static) appends the round's analytic ICI word
     accounting as a third output (:class:`~tpu_gossip.dist.transport.
-    IciRound`)."""
+    IciRound`). ``stream`` (traffic/) runs the streaming serving stage
+    through the shared ``advance_round`` with the same
+    global-shape-draw guarantee — loaded swarms keep each engine
+    family's parity contract."""
     from tpu_gossip.core.matching_topology import MatchingPlan
 
     if isinstance(sg, MatchingPlan):
@@ -861,7 +865,8 @@ def gossip_round_dist(
         return gossip_round_dist_matching(state, cfg, sg, mesh,
                                           scenario=scenario, growth=growth,
                                           transport=transport,
-                                          collect_ici=collect_ici)
+                                          collect_ici=collect_ici,
+                                          stream=stream)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -879,7 +884,7 @@ def gossip_round_dist(
         )
         out = advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, growth=growth,
+            k_join, receptive, growth=growth, stream=stream,
         )
         if not collect_ici:
             return out
@@ -900,7 +905,7 @@ def gossip_round_dist(
     out = advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem, growth=growth,
+        fault_held=held, fstats=telem, growth=growth, stream=stream,
     )
     if not collect_ici:
         return out
@@ -948,6 +953,7 @@ def simulate_dist(
     growth=None,
     transport=None,
     collect_ici: bool = False,
+    stream=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -960,11 +966,14 @@ def simulate_dist(
     (dist/transport.py) selects the sparsity-adaptive exchange;
     ``collect_ici`` (static) returns ``(state, (stats, ici))`` with the
     per-round analytic ICI word trajectory stacked alongside the stats.
+    ``stream`` threads a compiled streaming workload (traffic/) exactly
+    as in the local engine.
     """
 
     def body(carry, _):
         out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
-                                scenario, growth, transport, collect_ici)
+                                scenario, growth, transport, collect_ici,
+                                stream)
         if collect_ici:
             nxt, stats, ici = out
             return nxt, (stats, ici)
@@ -992,6 +1001,7 @@ def run_until_coverage_dist(
     growth=None,
     transport=None,
     collect_ici: bool = False,
+    stream=None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
@@ -1016,7 +1026,8 @@ def run_until_coverage_dist(
 
         def body(st: SwarmState) -> SwarmState:
             nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
-                                       scenario, growth, transport)
+                                       scenario, growth, transport,
+                                       stream=stream)
             return nxt
 
         return jax.lax.while_loop(cond_plain, body, state)
@@ -1027,7 +1038,8 @@ def run_until_coverage_dist(
     def body_ici(carry):
         st, acc = carry
         nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
-                                        scenario, growth, transport, True)
+                                        scenario, growth, transport, True,
+                                        stream)
         return nxt, accumulate_ici(acc, ici)
 
     return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))

@@ -79,6 +79,7 @@ def tail_reference(
     *,
     forward_once: bool,
     sir_recover_rounds: int,
+    expired: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """The historical pass sequence, verbatim — the bitwise oracle.
 
@@ -86,7 +87,10 @@ def tail_reference(
     resets as a SECOND sweep over the just-produced arrays — exactly the
     order ``advance_round`` used before the fusion, so regressions in the
     fused paths are caught against the original semantics, not against
-    themselves.
+    themselves. ``expired`` ((M,) bool, the streaming plane's age-out —
+    traffic/engine.slot_expiry) clears whole slot COLUMNS as a final
+    sweep: the recycled slot's message is gone everywhere at once, a
+    delivery into it this round dies with it.
     """
     inc = incoming & receptive
     new_seen = seen | inc
@@ -104,6 +108,12 @@ def tail_reference(
         new_fwd = new_fwd & ~fc
         new_ir = jnp.where(fc, -1, new_ir)
         new_rec = new_rec & ~fc
+    if expired is not None:
+        ec = expired[None, :]
+        new_seen = new_seen & ~ec
+        new_fwd = new_fwd & ~ec
+        new_ir = jnp.where(ec, -1, new_ir)
+        new_rec = new_rec & ~ec
     return new_seen, new_fwd, new_ir, new_rec
 
 
@@ -120,14 +130,25 @@ def tail_fused(
     *,
     forward_once: bool,
     sir_recover_rounds: int,
+    expired: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Single-traversal form: each output is one expression, materialized
-    once, with the fresh mask folded into the producing select instead of a
-    second sweep. Bitwise-equal to :func:`tail_reference` (pure boolean
-    algebra: ``(a | b) & ~f`` has one value however it is scheduled)."""
+    once, with the fresh ROW mask and the streaming plane's expired
+    COLUMN mask folded into the producing selects instead of extra
+    sweeps. Bitwise-equal to :func:`tail_reference` (pure boolean
+    algebra: ``(a | b) & ~f & ~e`` has one value however it is
+    scheduled)."""
     fc = _fresh_col(fresh)
     inc = incoming & receptive
-    keep = None if fc is None else ~fc
+    # keep = ~fresh_row & ~expired_col, folded to one (broadcast) operand
+    if fc is None and expired is None:
+        keep = None
+    elif fc is None:
+        keep = ~expired[None, :]
+    elif expired is None:
+        keep = ~fc
+    else:
+        keep = ~fc & ~expired[None, :]
     new_seen = (seen | inc) if keep is None else ((seen | inc) & keep)
     if forward_once:
         new_fwd = (forwarded | transmit) if keep is None else (
@@ -143,14 +164,17 @@ def tail_fused(
         )
     else:
         new_rec = recovered
-    if fc is not None:
-        new_ir = jnp.where(fc, -1, new_ir)
+    if keep is not None:
+        new_ir = jnp.where(keep, new_ir, -1)
         new_rec = new_rec & keep
     return new_seen, new_fwd, new_ir, new_rec
 
 
-def _tail_kernel(forward_once: bool, sir: int, has_fresh: bool):
+def _tail_kernel(
+    forward_once: bool, sir: int, has_fresh: bool, has_expired: bool
+):
     """One grid step: the whole tail over a (block_rows, M) row window."""
+    needs_fwd = forward_once or has_fresh or has_expired
 
     def kernel(*refs):
         it = iter(refs)
@@ -159,14 +183,15 @@ def _tail_kernel(forward_once: bool, sir: int, has_fresh: bool):
         rec_ref = next(it)
         inc_ref = next(it)
         recp_ref = next(it)
-        fwd_ref = next(it) if (forward_once or has_fresh) else None
+        fwd_ref = next(it) if needs_fwd else None
         tx_ref = next(it) if forward_once else None
         fresh_ref = next(it) if has_fresh else None
+        exp_ref = next(it) if has_expired else None
         rnd_ref = next(it)
         o_seen = next(it)
         o_ir = next(it)
         o_rec = next(it)
-        o_fwd = next(it) if (forward_once or has_fresh) else None
+        o_fwd = next(it) if needs_fwd else None
 
         rnd = rnd_ref[0, 0]
         seen = seen_ref[...]
@@ -174,6 +199,9 @@ def _tail_kernel(forward_once: bool, sir: int, has_fresh: bool):
         keep = None
         if has_fresh:
             keep = ~fresh_ref[...]  # (blk, 1) broadcasts over the slot dim
+        if has_expired:
+            ec = ~exp_ref[...]  # (1, M) broadcasts over the row dim
+            keep = ec if keep is None else keep & ec
         new_seen = seen | inc
         if keep is not None:
             new_seen = new_seen & keep
@@ -184,8 +212,8 @@ def _tail_kernel(forward_once: bool, sir: int, has_fresh: bool):
         rec = rec_ref[...]
         if sir > 0:
             rec = rec | ((new_ir >= 0) & (rnd - new_ir >= sir))
-        if has_fresh:
-            new_ir = jnp.where(fresh_ref[...], -1, new_ir)
+        if keep is not None:
+            new_ir = jnp.where(keep, new_ir, -1)
             rec = rec & keep
         o_ir[...] = new_ir
         o_rec[...] = rec
@@ -214,25 +242,30 @@ def tail_pallas(
     *,
     forward_once: bool,
     sir_recover_rounds: int,
+    expired: jax.Array | None = None,
     interpret: bool | None = None,
     block_rows: int = BLOCK_ROWS,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """The tail as ONE Pallas launch over row blocks (same math, same bits).
 
-    When neither forward-once nor a churn rejoin touches ``forwarded``, the
-    kernel skips it entirely and the input passes through untouched — the
-    common headline configuration moves three outputs, not four.
+    When neither forward-once nor a churn rejoin nor a streaming age-out
+    touches ``forwarded``, the kernel skips it entirely and the input
+    passes through untouched — the common headline configuration moves
+    three outputs, not four. ``expired`` ((M,) bool) rides as one
+    replicated (1, M) operand every grid step reads.
     """
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     n, m = seen.shape
     has_fresh = fresh is not None
-    needs_fwd = forward_once or has_fresh
+    has_expired = expired is not None
+    needs_fwd = forward_once or has_fresh or has_expired
     blk = min(block_rows, n)
     grid = (-(-n // blk),)
 
     row_spec = pl.BlockSpec((blk, m), lambda i: (i, 0))
     one_spec = pl.BlockSpec((blk, 1), lambda i: (i, 0))
+    col_spec = pl.BlockSpec((1, m), lambda i: (0, 0))
     rnd_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
 
     args = [seen, infected_round, recovered, incoming, receptive]
@@ -246,6 +279,9 @@ def tail_pallas(
     if has_fresh:
         args.append(fresh[:, None])
         in_specs.append(one_spec)
+    if has_expired:
+        args.append(expired[None, :])
+        in_specs.append(col_spec)
     args.append(jnp.asarray(rnd, jnp.int32).reshape(1, 1))
     in_specs.append(rnd_spec)
 
@@ -260,7 +296,7 @@ def tail_pallas(
         out_specs.append(row_spec)
 
     outs = pl.pallas_call(
-        _tail_kernel(forward_once, sir_recover_rounds, has_fresh),
+        _tail_kernel(forward_once, sir_recover_rounds, has_fresh, has_expired),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -285,18 +321,24 @@ def round_tail(
     *,
     forward_once: bool,
     sir_recover_rounds: int,
+    expired: jax.Array | None = None,
     impl: str = "fused",
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Dispatch to one of the three bit-identical tail implementations.
 
     Returns ``(seen, forwarded, infected_round, recovered)``. ``fresh``
-    (N,) bool marks slots a churn rejoin reset this round (None = no join
-    configured — the masks compile away entirely).
+    (N,) bool marks slots a churn rejoin reset this round; ``expired``
+    (M,) bool marks slot COLUMNS the streaming age-out recycles this
+    round (traffic/engine.slot_expiry). Either None compiles its masks
+    away entirely — the no-churn / no-stream rounds pay nothing.
     """
     if impl not in TAIL_IMPLS:
         raise ValueError(f"unknown tail impl {impl!r}; choose from {TAIL_IMPLS}")
-    kw = dict(forward_once=forward_once, sir_recover_rounds=sir_recover_rounds)
+    kw = dict(
+        forward_once=forward_once, sir_recover_rounds=sir_recover_rounds,
+        expired=expired,
+    )
     if impl == "pallas":
         return tail_pallas(
             seen, forwarded, infected_round, recovered, incoming, receptive,

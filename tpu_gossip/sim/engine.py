@@ -76,13 +76,27 @@ class RoundStats(NamedTuple):
     # the per-round log sweep is priced only on growing runs)
     n_members: jax.Array  # i32 — slots with exists=True
     degree_gamma: jax.Array  # f32 — running Hill γ-MLE (0 when off/thin tail)
+    # streaming serving plane (traffic/) — all 0 unless a stream is
+    # active (absent workload classes cost nothing, counters included).
+    # The two (M,) vectors are the per-slot observability the host-side
+    # steady-state report (sim.metrics.steady_state_report) reconstructs
+    # per-MESSAGE latencies from: integer sums, so they stay bit-exact
+    # across engine layouts like every other integer stat.
+    stream_offered: jax.Array  # i32 — arrivals the process produced
+    stream_injected: jax.Array  # i32 — arrivals that landed
+    stream_conflated: jax.Array  # i32 — k=1 conflations / k>=2 Bloom-FP drops
+    stream_expired: jax.Array  # i32 — leases the age-out recycled
+    slot_infected: jax.Array  # i32 (M,) — live peers holding each slot
+    slot_age: jax.Array  # i32 (M,) — rounds since each slot's lease (-1 free)
 
 
 def _stats(
-    state: SwarmState, msgs_sent: jax.Array, fstats=None, growth=None
+    state: SwarmState, msgs_sent: jax.Array, fstats=None, growth=None,
+    stream=None, stel=None,
 ) -> RoundStats:
     live = state.alive & ~state.declared_dead
     z = jnp.zeros((), dtype=jnp.int32)
+    m = state.seen.shape[1]
     if growth is None:
         gamma = jnp.zeros((), dtype=jnp.float32)
     else:
@@ -95,6 +109,19 @@ def _stats(
             ),
             live, growth.gamma_d_min,
         )
+    if stream is None:
+        slot_infected = jnp.zeros((m,), dtype=jnp.int32)
+        slot_age = jnp.zeros((m,), dtype=jnp.int32)
+    else:
+        # the (N, M) column reduction is priced only on streaming runs;
+        # integer sums are order-independent, so the track is bit-exact
+        # across engine layouts (unlike a float per-slot coverage)
+        slot_infected = jnp.sum(
+            state.seen & live[:, None], axis=0, dtype=jnp.int32
+        )
+        slot_age = jnp.where(
+            state.slot_lease >= 0, state.round - state.slot_lease, -1
+        ).astype(jnp.int32)
     return RoundStats(
         coverage=state.coverage(0),  # the one coverage definition (state.py)
         msgs_sent=msgs_sent.astype(jnp.int32),
@@ -106,6 +133,12 @@ def _stats(
         msgs_delivered=z if fstats is None else fstats.msgs_delivered,
         n_members=jnp.sum(state.exists).astype(jnp.int32),
         degree_gamma=gamma,
+        stream_offered=z if stel is None else stel.offered,
+        stream_injected=z if stel is None else stel.injected,
+        stream_conflated=z if stel is None else stel.conflated,
+        stream_expired=z if stel is None else stel.expired,
+        slot_infected=slot_infected,
+        slot_age=slot_age,
     )
 
 
@@ -684,9 +717,10 @@ def advance_round(
     fault_held: jax.Array | None = None,
     fstats=None,
     growth=None,
+    stream=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Everything after dissemination: dedup-merge, SIR, liveness, churn,
-    growth admission.
+    growth admission, streaming age-out + injection.
 
     Shared by the local round (:func:`gossip_round`) and the multi-chip
     round (dist/mesh.py) so the protocol state machine exists exactly once.
@@ -721,6 +755,17 @@ def advance_round(
     the fixed-n trajectory bit for bit). Admitted rows' slot arrays are
     already virgin (a never-existed row was never receptive), so the
     fused tail needs no extra reset sweep for them.
+
+    ``stream`` (a :class:`~tpu_gossip.traffic.CompiledStream`) runs the
+    streaming serving stage (traffic/engine.py): slots whose lease aged
+    past ``stream.ttl`` are recycled THROUGH the fused tail (one more
+    mask folded into the producing selects — the (N, M) bitmap becomes a
+    sliding window over live messages, and the delay buffer drops the
+    recycled columns' held bits), then the round's arrivals inject
+    AFTER the tail from the dedicated ``TRAFFIC_STREAM_SALT`` stream at
+    global shape — the protocol's split and the fault/growth draws are
+    untouched, so ``stream=None`` and a zero-rate stream reproduce the
+    fixed single-epidemic trajectory bit for bit.
     """
     # --- liveness (row-level) ---------------------------------------------
     # a blacked-out node is cut off from the heartbeat plane too: it emits
@@ -904,14 +949,45 @@ def advance_round(
         admitted_by = grown["admitted_by"]
         degree_credit = grown["degree_credit"]
 
+    # --- streaming age-out (traffic/): slot columns past TTL recycle ------
+    # the expired mask folds into the fused tail below like the churn
+    # fresh mask; the delay buffer drops the recycled columns' held bits
+    # (they belong to the recycled message). stream=None leaves the lease
+    # table and the buffer carried untouched — the no-stream hot path.
+    expired = None
+    slot_lease = state.slot_lease
+    held = state.fault_held if fault_held is None else fault_held
+    if stream is not None:
+        from tpu_gossip.traffic.engine import slot_expiry
+
+        expired = slot_expiry(slot_lease, rnd, stream.ttl)
+        slot_lease = jnp.where(expired, -1, slot_lease)
+        held = held & ~expired[None, :]
+
     # --- fused slot tail: dedup merge + latch + SIR + fresh resets --------
     seen, forwarded, infected_round, recovered = round_tail(
         state.seen, state.forwarded, state.infected_round, state.recovered,
         incoming, receptive, transmit, fresh, rnd,
         forward_once=cfg.forward_once,
         sir_recover_rounds=cfg.sir_recover_rounds,
+        expired=expired,
         impl=tail,
     )
+
+    # --- streaming injection (traffic/): post-tail, so a round-r arrival
+    # first transmits in round r+1 and a just-recycled slot is
+    # immediately re-leasable — the sliding window advances in one round
+    stel = None
+    if stream is not None:
+        from tpu_gossip.traffic.engine import apply_stream
+
+        seen, infected_round, slot_lease, stel = apply_stream(
+            stream, state.rng, rnd, jnp.sum(expired, dtype=jnp.int32),
+            seen=seen, infected_round=infected_round,
+            slot_lease=slot_lease, row_ptr=state.row_ptr,
+            col_idx=state.col_idx, exists=exists, alive=alive,
+            declared_dead=declared_dead,
+        )
 
     new_state = SwarmState(
         row_ptr=state.row_ptr,
@@ -927,19 +1003,20 @@ def advance_round(
         declared_dead=declared_dead,
         rewired=rewired,
         rewire_targets=rewire_targets,
-        fault_held=state.fault_held if fault_held is None else fault_held,
+        fault_held=held,
         join_round=join_round,
         admitted_by=admitted_by,
         degree_credit=degree_credit,
+        slot_lease=slot_lease,
         rng=key,
         round=rnd,
     )
-    return new_state, _stats(new_state, msgs_sent, fstats, growth)
+    return new_state, _stats(new_state, msgs_sent, fstats, growth, stream, stel)
 
 
 def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
-    scenario=None, growth=None,
+    scenario=None, growth=None, stream=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -960,6 +1037,14 @@ def gossip_round(
     ``growth=None`` and an exhausted schedule are likewise bit-identical
     to the fixed-n round. Composes with ``scenario``: a ``join_burst``
     phase adds admissions on top of the schedule's per-round rate.
+
+    ``stream`` (a :class:`~tpu_gossip.traffic.CompiledStream`) runs the
+    streaming serving stage (per-round injection + slot age-out,
+    traffic/): its draws derive from the registered
+    ``TRAFFIC_STREAM_SALT`` stream, so ``stream=None`` — and a zero-rate
+    stream — reproduce the single-epidemic trajectory bit for bit.
+    Composes with both: "flash crowd joins while a rack fails under full
+    traffic" is one round call.
     """
     validate_rewire_width(state, cfg)
     rnd = state.round + 1
@@ -972,7 +1057,7 @@ def gossip_round(
         )
         return advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, tail=tail, growth=growth,
+            k_join, receptive, tail=tail, growth=growth, stream=stream,
         )
     from tpu_gossip.faults.inject import scenario_dissemination
 
@@ -988,7 +1073,7 @@ def gossip_round(
     return advance_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, tail=tail, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem, growth=growth,
+        fault_held=held, fstats=telem, growth=growth, stream=stream,
     )
 
 
@@ -999,7 +1084,7 @@ def gossip_round(
 )
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
-    tail: str = "fused", scenario=None, growth=None,
+    tail: str = "fused", scenario=None, growth=None, stream=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -1013,12 +1098,16 @@ def simulate(
     scan: the tables are loop-invariant operands, the round counter in the
     carry is the scenario cursor. ``growth`` threads a compiled admission
     schedule (growth/) the same way — the registry plane in the carry is
-    its cursor.
+    its cursor. ``stream`` threads a compiled streaming workload
+    (traffic/) — the slot-lease table in the carry is its cursor, and
+    the stacked per-round stats carry the steady-state track
+    (sim.metrics.steady_state_report consumes it).
     """
 
     def body(carry, _):
         nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
-                                  scenario=scenario, growth=growth)
+                                  scenario=scenario, growth=growth,
+                                  stream=stream)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -1039,6 +1128,7 @@ def run_until_coverage(
     tail: str = "fused",
     scenario=None,
     growth=None,
+    stream=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -1052,7 +1142,10 @@ def run_until_coverage(
     ``scenario`` injects a compiled fault schedule (faults/); rounds past
     its horizon run quiescent, so the loop can outlive the scenario.
     ``growth`` admits per-round join batches (growth/); rounds past its
-    schedule run fixed-n.
+    schedule run fixed-n. ``stream`` injects a streaming workload
+    (traffic/) — note the stop condition still reads ``coverage(slot)``,
+    which a recycled slot resets; steady-state measurement wants the
+    fixed-horizon :func:`simulate` instead (the CLI enforces this).
     """
 
     def cond(s: SwarmState) -> jax.Array:
@@ -1060,7 +1153,7 @@ def run_until_coverage(
 
     def body(s: SwarmState) -> SwarmState:
         nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
-                              growth=growth)
+                              growth=growth, stream=stream)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

@@ -31,6 +31,8 @@ __all__ = [
     "stats_rows",
     "recoverage_rounds",
     "phase_report",
+    "stream_episodes",
+    "steady_state_report",
 ]
 
 
@@ -150,14 +152,18 @@ def bench_swarm(
 
 
 def stats_rows(stats: RoundStats) -> Iterable[dict]:
-    """RoundStats (stacked over rounds) → per-round dict rows."""
+    """RoundStats (stacked over rounds) → per-round dict rows.
+
+    Vector fields (the streaming plane's per-slot tracks) emit as JSON
+    lists; scalars stay scalars."""
     fields = stats._asdict()
     arrays = {k: np.asarray(v) for k, v in fields.items()}
     n = len(arrays["coverage"])
     for r in range(n):
         row = {"round": r + 1}
         for k, v in arrays.items():
-            row[k] = v[r].item()
+            val = v[r]
+            row[k] = val.item() if val.ndim == 0 else val.tolist()
         yield row
 
 
@@ -253,6 +259,122 @@ def phase_report(
             )
         rows.append(row)
     return rows
+
+
+def stream_episodes(stats: RoundStats, target: float = 0.99) -> list[dict]:
+    """Per-MESSAGE lease episodes reconstructed from a streaming run's
+    per-round per-slot tracks (the ``slot_age``/``slot_infected``
+    vectors RoundStats carries under a stream).
+
+    A lease episode starts where a slot's age reads 0 (the injection
+    round) and ends where the age resets (a new lease) or reads -1 (the
+    age-out freed it). Its message COMPLETES at the first round its
+    slot's live coverage reaches ``target`` of that round's alive count
+    — the age at that round IS the message's rounds-to-coverage, so
+    per-message latency percentiles need no extra device state at all.
+    Episodes still open at the horizon are censored (``end`` -1, not
+    counted as expired). Rows: ``slot``, ``start_round`` (1-based),
+    ``end_round`` (-1 open), ``completed_age`` (-1 never),
+    ``peak_coverage``.
+    """
+    age = np.asarray(stats.slot_age)
+    infected = np.asarray(stats.slot_infected)
+    alive = np.maximum(np.asarray(stats.n_alive), 1)
+    horizon, m = age.shape
+    cov = infected / alive[:, None]
+    episodes: list[dict] = []
+    for s in range(m):
+        start = None
+        for r in range(horizon):
+            a = age[r, s]
+            if a == 0 and start is not None:
+                episodes.append(_close_episode(s, start, r, cov, age, target))
+                start = r
+            elif a == 0:
+                start = r
+            elif a < 0 and start is not None:
+                episodes.append(_close_episode(s, start, r, cov, age, target))
+                start = None
+        if start is not None:
+            ep = _close_episode(s, start, horizon, cov, age, target)
+            ep["end_round"] = -1  # censored: the horizon cut it, not the TTL
+            episodes.append(ep)
+    return episodes
+
+
+def _close_episode(s, start, end, cov, age, target):
+    span = cov[start:end, s]
+    hit = np.nonzero(span >= target)[0]
+    return {
+        "slot": s,
+        "start_round": start + 1,
+        "end_round": end,
+        "completed_age": int(age[start + hit[0], s]) if hit.size else -1,
+        "peak_coverage": float(span.max()) if span.size else 0.0,
+    }
+
+
+def steady_state_report(
+    stats: RoundStats,
+    *,
+    target: float = 0.99,
+    round_seconds: float = 5.0,
+    warmup_rounds: int = 0,
+) -> dict:
+    """The streaming run's steady-state summary (docs/streaming_plane.md).
+
+    Aggregates the injection counters and the per-message episodes into
+    the serving metrics the ROADMAP's millions-of-users claim is
+    measured by: delivered msgs/sec, p50/p99 rounds-to-coverage PER
+    MESSAGE, conflation/Bloom-FP rate under load, and the
+    delivered-vs-offered ratio whose collapse marks the saturation
+    point. ``warmup_rounds`` drops the window-filling prefix (one TTL is
+    the natural choice) from the counters and skips episodes injected
+    inside it, so the report reads the steady state, not the ramp.
+    Host-side, like every reporting helper here.
+    """
+    horizon = len(np.asarray(stats.coverage))
+    w = min(max(warmup_rounds, 0), horizon)
+    rounds = max(horizon - w, 1)
+    counters = {
+        f: int(np.asarray(getattr(stats, f"stream_{f}"))[w:].sum())
+        for f in ("offered", "injected", "conflated", "expired")
+    }
+    eps = [
+        e for e in stream_episodes(stats, target) if e["start_round"] > w
+    ]
+    done = [e["completed_age"] for e in eps if e["completed_age"] >= 0]
+    ended = [e for e in eps if e["end_round"] >= 0]
+    done_ended = sum(1 for e in ended if e["completed_age"] >= 0)
+    expired_eps = len(ended) - done_ended
+    lat = np.asarray(done, dtype=np.float64)
+    out = {
+        "rounds_measured": rounds,
+        "warmup_rounds": w,
+        **{f"msgs_{k}": v for k, v in counters.items()},
+        "offered_per_round": round(counters["offered"] / rounds, 3),
+        "injected_per_round": round(counters["injected"] / rounds, 3),
+        "conflation_rate": round(
+            counters["conflated"] / max(counters["offered"], 1), 4
+        ),
+        "episodes": len(eps),
+        "episodes_completed": len(done),
+        "episodes_expired_uncovered": expired_eps,
+        "delivered_per_round": round(len(done) / rounds, 3),
+        "delivered_msgs_per_sec": round(
+            len(done) / (rounds * round_seconds), 4
+        ),
+        # of the episodes whose lease CLOSED inside the window, the
+        # fraction that had covered — censored (still-open) episodes
+        # judge neither way, so the ratio cannot exceed 1
+        "delivery_ratio": round(done_ended / max(len(ended), 1), 4),
+        "rounds_to_coverage": {
+            "p50": float(np.percentile(lat, 50)) if lat.size else None,
+            "p99": float(np.percentile(lat, 99)) if lat.size else None,
+            "mean": round(float(lat.mean()), 3) if lat.size else None,
+        },
+    }
+    return out
 
 
 def expected_conflations(n_rumors: int, msg_slots: int) -> float:

@@ -1,0 +1,39 @@
+"""Streaming serving plane: sustained many-message traffic on the
+slot/Bloom dedup engine (docs/streaming_plane.md).
+
+``compile_stream`` (traffic/plan.py) turns an injection-rate + origin-law
+config into a :class:`CompiledStream` pytree; ``apply_stream`` and
+``slot_expiry`` (traffic/engine.py) run as the streaming stage of the
+shared ``sim.engine.advance_round`` on every delivery engine. The
+injection draws come from the registered ``TRAFFIC_STREAM_SALT`` stream
+(core/streams.py) at global shape, so the local ↔ sharded bit-identity
+contract extends to loaded swarms.
+"""
+
+from tpu_gossip.traffic.engine import (
+    TRAFFIC_STREAM_SALT,
+    StreamTelemetry,
+    apply_stream,
+    slot_expiry,
+)
+from tpu_gossip.traffic.plan import (
+    ORIGIN_LAWS,
+    CompiledStream,
+    StreamError,
+    compile_stream,
+    default_max_inject,
+    min_feasible_ttl,
+)
+
+__all__ = [
+    "TRAFFIC_STREAM_SALT",
+    "StreamTelemetry",
+    "apply_stream",
+    "slot_expiry",
+    "ORIGIN_LAWS",
+    "CompiledStream",
+    "StreamError",
+    "compile_stream",
+    "default_max_inject",
+    "min_feasible_ttl",
+]

@@ -1,0 +1,215 @@
+"""In-round injection + slot age-out: the streaming engine's on-device half.
+
+The streaming stage runs INSIDE the jitted round as part of
+``sim.engine.advance_round`` — shared by all three delivery engines, so
+the serving plane exists once and cannot drift between them:
+
+- **age-out** (:func:`slot_expiry`): a slot whose lease is ``ttl`` rounds
+  old is recycled — its column of every slot array (seen / forwarded /
+  infected_round / recovered / fault_held) is cleared THROUGH the fused
+  round tail (``kernels.round_tail``'s ``expired`` mask rides the same
+  producing selects as the churn fresh mask), and its lease resets to
+  free. The (N, M) bitmap is thereby a SLIDING WINDOW over live
+  messages, the bounded-memory dedup regime docs/dedup_semantics.md
+  specifies, now under sustained load.
+- **injection** (:func:`apply_stream`): the round's arrivals (Poisson or
+  burst-modulated — traffic/plan.py) each draw an origin by the
+  configured law and ``k_hashes`` uniform slots, then land
+  SEQUENTIALLY: with k=1 a message landing on a live lease is CONFLATED
+  (it rides the incumbent epidemic — counted, never suppressed); with
+  k>=2 a message whose k slots ALL carry live leases is a Bloom false
+  positive and is suppressed at ingestion (the classic trade,
+  docs/dedup_semantics.md). Free slots among a landing message's draws
+  take its lease. The origin's bits are set post-tail, so a round-r
+  injection first transmits in round r+1.
+
+Every draw comes from ``fold_in(state.rng, TRAFFIC_STREAM_SALT)`` at
+GLOBAL shape outside ``shard_map`` — a derivation parallel to the
+protocol's 5-way split and the fault/growth streams, overlapping none of
+them — so the local ↔ sharded bit-identity contract extends to loaded
+swarms, and a zero-rate stream reproduces the fixed single-epidemic
+trajectory bit for bit (both test-pinned, tests/sim/test_traffic.py).
+All shapes are static (``max_inject`` arrivals drawn every round
+regardless of the traced count — stream positions depend only on the
+round, so rate edits never shift later rounds' randomness) and the
+per-batch scan carries only the (M,) lease table.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_gossip.core.streams import TRAFFIC_STREAM_SALT
+
+__all__ = [
+    "TRAFFIC_STREAM_SALT",
+    "StreamTelemetry",
+    "slot_expiry",
+    "apply_stream",
+]
+
+
+class StreamTelemetry(NamedTuple):
+    """Per-round streaming counters for RoundStats (all scalar int32)."""
+
+    offered: jax.Array  # arrivals the process produced this round
+    injected: jax.Array  # arrivals that landed (live origin, not suppressed)
+    conflated: jax.Array  # k=1: landed on a live lease; k>=2: Bloom-FP suppressed
+    expired: jax.Array  # leases the age-out recycled this round
+
+
+def slot_expiry(slot_lease: jax.Array, rnd: jax.Array, ttl: int) -> jax.Array:
+    """(M,) bool — slots whose lease ages out at round ``rnd``.
+
+    A message injected at round r expires at round r + ttl: it had
+    exactly ``ttl`` dissemination rounds (its injection round r set bits
+    post-tail, rounds r+1..r+ttl relayed them, round r+ttl's tail clears
+    the column). Free slots (lease -1) never expire.
+    """
+    return (slot_lease >= 0) & (rnd - slot_lease >= ttl)
+
+
+def apply_stream(
+    stream,
+    rng: jax.Array,
+    rnd: jax.Array,
+    expired_count: jax.Array,
+    *,
+    seen: jax.Array,
+    infected_round: jax.Array,
+    slot_lease: jax.Array,
+    row_ptr: jax.Array,
+    col_idx: jax.Array,
+    exists: jax.Array,
+    alive: jax.Array,
+    declared_dead: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, StreamTelemetry]:
+    """Inject one round's arrivals; returns (seen, infected_round,
+    slot_lease, telemetry).
+
+    ``rng`` is the round's ROOT key (``state.rng``) — the traffic stream
+    derives by ``fold_in`` and consumes nothing of the protocol's 5-way
+    split. Runs AFTER the fused tail and the churn/growth row stages, so
+    origins are gated on the round's FINAL liveness (an arrival whose
+    drawn origin is down is lost at ingestion — offered but not injected
+    — exactly a user knocking on a dead peer) and a slot the age-out just
+    recycled is immediately re-leasable. Slot draws are uniform, the
+    device-side analogue of :func:`~tpu_gossip.core.state.message_slots`'
+    independent hash planes, so the measured conflation/Bloom-FP rates
+    conform to the closed-form predictors in ``sim.metrics``.
+    """
+    n = exists.shape[0]
+    m = seen.shape[1]
+    j, k = stream.max_inject, stream.k_hashes
+
+    k_stream = jax.random.fold_in(rng, TRAFFIC_STREAM_SALT)
+    k_count, k_origin, k_hot, k_slot, k_fb = jax.random.split(k_stream, 5)
+
+    rate = stream.rate
+    if stream.burst_every > 0:
+        burst = (rnd % stream.burst_every) == 0
+        rate = rate * jnp.where(burst, stream.burst_mult, 1.0)
+    n_arr = jnp.minimum(
+        jax.random.poisson(k_count, rate, dtype=jnp.int32), j
+    )
+    live = jnp.arange(j) < n_arr
+
+    if stream.origins == "degree":
+        # uniform index into the CSR endpoint list IS degree-proportional
+        # sampling (the re-wiring draws' repeated-endpoints trick); draw
+        # over the REAL edge span, not a remat capacity tail
+        if col_idx.shape[0] == 1 and row_ptr.shape[0] > 3:
+            raise ValueError(
+                "degree-weighted stream origins read the CSR endpoint "
+                "list, but this graph was built without one "
+                "(matching_powerlaw_graph(export_csr=False)); rebuild "
+                "with export_csr=True or use origins='uniform'"
+            )
+        e_real = jnp.maximum(row_ptr[-1], 1)
+        draw = col_idx[
+            jax.random.randint(k_origin, (j,), 0, e_real)
+        ].astype(jnp.int32)
+        # an endpoint draw can land on an erased/pad entry (device-built
+        # CSRs point erased edges at the sentinel row) — fall back to a
+        # uniform member draw instead of losing the arrival: the law is
+        # degree-weighted with an O(erasure-rate) uniform contamination,
+        # and the realized injection rate stays the configured one
+        fallback = stream.origin_rows[
+            jax.random.randint(k_fb, (j,), 0, stream.origin_rows.shape[0])
+        ]
+        origins = jnp.where(
+            exists[jnp.clip(draw, 0, n - 1)], draw, fallback
+        )
+    elif stream.origins == "hotspot":
+        k_hot_pick, k_hot_row = jax.random.split(k_hot)
+        uni = stream.origin_rows[
+            jax.random.randint(k_origin, (j,), 0, stream.origin_rows.shape[0])
+        ]
+        hot = stream.hot_rows[
+            jax.random.randint(k_hot_row, (j,), 0, stream.hot_rows.shape[0])
+        ]
+        pick_hot = jax.random.uniform(k_hot_pick, (j,)) < stream.hot_weight
+        origins = jnp.where(pick_hot, hot, uni)
+    else:  # uniform over the initial membership
+        origins = stream.origin_rows[
+            jax.random.randint(k_origin, (j,), 0, stream.origin_rows.shape[0])
+        ]
+
+    safe_o = jnp.clip(origins, 0, n - 1)
+    ok = (
+        live
+        & exists[safe_o]
+        & alive[safe_o]
+        & ~declared_dead[safe_o]
+    )
+    slots = jax.random.randint(k_slot, (j, k), 0, m).astype(jnp.int32)
+
+    # sequential landing over the batch: arrival i+1 sees the leases
+    # arrival i took (the per-message semantics the closed-form
+    # predictors assume). The scan carries only the (M,) lease table —
+    # all draws happen above, outside the loop (one trace, no
+    # loop-invariant key redraws)
+    def land(lease, x):
+        sl, ok_i = x  # (k,) int32, scalar bool
+        cur = lease[sl]
+        leased = cur >= 0
+        if k == 1:
+            suppressed = jnp.zeros((), dtype=bool)
+            conf = ok_i & leased[0]
+        else:
+            all_leased = jnp.all(leased)
+            suppressed = all_leased
+            conf = ok_i & all_leased
+        landed = ok_i & ~suppressed
+        # free slots among the draws take the lease; live leases keep
+        # their (older, hence smaller) injection round under max
+        contrib = jnp.where(landed & ~leased, rnd, -1).astype(lease.dtype)
+        lease = lease.at[sl].max(contrib)
+        return lease, (landed, conf)
+
+    slot_lease, (landed, conflated) = jax.lax.scan(
+        land, slot_lease, (slots, ok)
+    )
+
+    rows = jnp.where(landed, safe_o, n)
+    inj = (
+        jnp.zeros_like(seen)
+        .at[
+            jnp.broadcast_to(rows[:, None], (j, k)).reshape(-1),
+            slots.reshape(-1),
+        ]
+        .set(True, mode="drop")
+    )
+    seen = seen | inj
+    infected_round = jnp.where(inj & (infected_round < 0), rnd, infected_round)
+
+    telem = StreamTelemetry(
+        offered=n_arr,
+        injected=jnp.sum(landed, dtype=jnp.int32),
+        conflated=jnp.sum(conflated, dtype=jnp.int32),
+        expired=expired_count.astype(jnp.int32),
+    )
+    return seen, infected_round, slot_lease, telem
