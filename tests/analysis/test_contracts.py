@@ -130,6 +130,52 @@ def test_broken_stream_stats_detected(monkeypatch):
     assert all("slot_age" in f.message for f in findings)
 
 
+def test_broken_control_stats_detected(monkeypatch):
+    """Re-type a controller stat: the stats contract declares
+    control_level/control_fanout/msgs_duplicate/control_refreshed as
+    scalar int32 — the reliability report and the AIMD observability
+    read them, so a silent dtype drift would corrupt the control
+    track."""
+    from tpu_gossip.sim import engine
+
+    orig = engine.gossip_round
+
+    def broken(state, cfg, plan=None, **kw):
+        st, stats = orig(state, cfg, plan, **kw)
+        return st, stats._replace(
+            control_fanout=stats.control_fanout.astype("float32")
+        )
+
+    monkeypatch.setattr(engine, "gossip_round", broken)
+    findings = audit_contracts(names=["gossip_round_local"])
+    assert findings, "audit missed a deliberate control_fanout dtype break"
+    assert all("control_fanout" in f.message for f in findings)
+
+
+def test_broken_control_cursor_detected(monkeypatch):
+    """Re-type the control cursor only on CONTROLLED rounds: the state
+    fixed point must pin control_lvl through the controlled entries the
+    matrix traces (the cursor rides scan carries and checkpoints)."""
+    import dataclasses
+
+    from tpu_gossip.sim import engine
+
+    orig = engine.gossip_round
+
+    def broken(state, cfg, plan=None, **kw):
+        st, stats = orig(state, cfg, plan, **kw)
+        if kw.get("control") is not None:
+            st = dataclasses.replace(
+                st, control_lvl=st.control_lvl.astype("int16")
+            )
+        return st, stats
+
+    monkeypatch.setattr(engine, "gossip_round", broken)
+    findings = audit_contracts(names=["gossip_round_local"])
+    assert findings, "audit missed a deliberate control-cursor break"
+    assert all("control" in f.message for f in findings)
+
+
 def test_broken_occupancy_header_detected(monkeypatch):
     """Drift the occupancy header to float32: the sparse-transport check
     must report it against the declared header_spec (both the runtime

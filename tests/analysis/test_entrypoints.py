@@ -39,13 +39,15 @@ def test_matrix_structural_coverage():
             for m in (1, 16):
                 assert f"local[{eng},{mode},m={m}]" in names
     for extra in ("churn", "sir", "churn-compact", "scenario", "growth",
-                  "stream", "scenario+growth", "scenario+growth+stream"):
+                  "stream", "scenario+growth", "scenario+growth+stream",
+                  "control", "scenario+growth+stream+control"):
         assert f"local[xla,{extra}]" in names
     for tail in ("reference", "fused", "pallas"):
         assert f"local[xla,tail={tail}]" in names
     assert "local[matching,scenario]" in names
     assert "local[matching,growth]" in names and "local[pallas,growth]" in names
     assert "local[matching,stream]" in names and "local[pallas,stream]" in names
+    assert "local[matching,control]" in names and "local[pallas,control]" in names
     assert "local[simulate]" in names and "local[run_until_coverage]" in names
     # dist half (present on this 8-device test host)
     assert {"dist-matching", "dist-bucketed"} <= engines
@@ -55,6 +57,7 @@ def test_matrix_structural_coverage():
         "dist[bucketed]", "dist[bucketed,growth]", "dist[bucketed,stream]",
         "dist[matching,simulate]", "dist[bucketed,run_until_coverage]",
         "dist[matching,sparse]", "dist[bucketed,sparse]",
+        "dist[matching,control]", "dist[bucketed,control]",
     ):
         assert n in names, n
 

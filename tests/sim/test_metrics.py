@@ -54,6 +54,8 @@ def test_jsonl_rows(setup):
         "n_members", "degree_gamma",
         "stream_offered", "stream_injected", "stream_conflated",
         "stream_expired", "slot_infected", "slot_age",
+        "control_level", "control_fanout", "msgs_duplicate",
+        "control_refreshed",
     }
     # the streaming plane's per-slot tracks emit as JSON lists (one entry
     # per dedup slot); scalars stay scalars — and an unloaded run's

@@ -116,6 +116,12 @@ def _stats_contract(stats, problems: list, leading=(), msg_slots=None) -> None:
         "stream_expired": (jnp.int32, ()),
         "slot_infected": (jnp.int32, (msg_slots,)),
         "slot_age": (jnp.int32, (msg_slots,)),
+        # adaptive-control track (control/): the level/fanout decision and
+        # the duplicate/refresh feedback counters — all scalar int32
+        "control_level": (jnp.int32, ()),
+        "control_fanout": (jnp.int32, ()),
+        "msgs_duplicate": (jnp.int32, ()),
+        "control_refreshed": (jnp.int32, ()),
     }
     for field, (dt, trailing) in declared.items():
         leaf = getattr(stats, field, None)

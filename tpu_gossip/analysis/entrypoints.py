@@ -6,8 +6,8 @@ fixed-point checks) and the jaxpr deep tier (deep/, dataflow passes over
 the traced equations). The matrix is the product the repo's bit-identity
 contract quantifies over: 3 local delivery engines × modes × msg_slots ×
 churn/SIR/compact × every protocol-tail implementation × chaos scenarios
-× growth schedules × streaming workloads × both mesh engines × sparse
-transport, plus the jitted loop entries (``simulate``/
+× growth schedules × streaming workloads × control policies × both mesh
+engines × sparse transport, plus the jitted loop entries (``simulate``/
 ``run_until_coverage`` and their dist twins). A new engine or mode added here is traced by BOTH tiers; a
 matrix entry added to one tier only cannot exist
 (tests/analysis/test_entrypoints.py pins the shared parametrization).
@@ -171,6 +171,20 @@ def _stream_plan(msg_slots: int, exists, *, k_hashes: int = 2):
         origin_rows=np.flatnonzero(np.asarray(exists)),
         k_hashes=min(k_hashes, msg_slots),
         burst_every=4,
+    )
+
+
+def _control_plan(ttl: int = 0):
+    """A small compiled control policy (control/) so the CONTROLLED round
+    traces its full structure — the level resolve, the width-``hi``
+    masked draws / scaled Bernoulli gates, the AIMD feedback reductions,
+    the PeerSwap refresh scatters — under the fixed-point contract.
+    Active bounds (lo < base < hi) + a refresh cadence exercise every
+    static branch; ``ttl`` > 0 adds the streaming lag signal."""
+    from tpu_gossip.control import compile_control
+
+    return compile_control(
+        target_ratio=0.9, fanout=1, lo=1, hi=3, refresh_every=2, ttl=ttl,
     )
 
 
@@ -405,6 +419,51 @@ def _local_entries() -> list[EntryPoint]:
         audit_check="gossip_round_local", build=build_all_three,
     ))
 
+    # the CONTROLLED round (control/): the feedback stage — masked
+    # width-hi draws, scaled Bernoulli gates, the AIMD reductions, the
+    # PeerSwap refresh — must keep the round a state fixed point on every
+    # local delivery engine (the level cursor rides scan/while carries
+    # and checkpoints)
+    for eng, graph, plan in engines:
+        def build_ctl(graph=graph, plan=plan):
+            st, cfg = ctx["state_for"](
+                graph, 16, mode="push_pull", rewire_slots=2,
+                churn_join_prob=0.02, churn_leave_prob=0.002,
+            )
+            cp = _control_plan()
+            return (
+                lambda s: engine.gossip_round(s, cfg, plan, control=cp),
+                st,
+            )
+
+        eps.append(EntryPoint(
+            name=f"local[{eng},control]", engine=eng, kind="round",
+            audit_check="gossip_round_local", build=build_ctl,
+        ))
+
+    # scenario + growth + stream + control: the FULL composition — FOUR
+    # parallel fold_in streams beside the protocol's split, the maximal
+    # salt-collision surface the deep lineage pass audits
+    def build_all_four():
+        st, cfg = ctx["state_for"](
+            ctx["dg"], 16, mode="push_pull", rewire_slots=2,
+            churn_join_prob=0.02, churn_leave_prob=0.002,
+        )
+        sc = _chaos_scenario(ctx["dg"].n_pad, _N_DEV)
+        gp = _growth_plan(ctx["dg"].n_pad, ctx["dg"].n_pad - 40)
+        sp = _stream_plan(16, ctx["dg"].exists)
+        cp = _control_plan(ttl=8)
+        return (
+            lambda s: engine.gossip_round(s, cfg, scenario=sc, growth=gp,
+                                          stream=sp, control=cp),
+            st,
+        )
+
+    eps.append(EntryPoint(
+        name="local[xla,scenario+growth+stream+control]", engine="xla",
+        kind="round", audit_check="gossip_round_local", build=build_all_four,
+    ))
+
     # the jitted loop entries (donating: state aliases the carry)
     def build_sim():
         st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
@@ -459,6 +518,8 @@ def _dist_entries() -> list[EntryPoint]:
                 kw["transport"] = tp.build_transport(graph_plan, mode="sparse")
             if kw.pop("stream", False):
                 kw["stream"] = _stream_plan(16, st.exists)
+            if kw.pop("control", False):
+                kw["control"] = _control_plan()
             if kind == "round":
                 fn = lambda s: mesh_mod.gossip_round_dist(  # noqa: E731
                     s, cfg, graph_plan, mesh, **kw
@@ -514,6 +575,23 @@ def _dist_entries() -> list[EntryPoint]:
     eps.append(dist_ep(
         "dist[bucketed,stream]", "dist-bucketed", "gossip_round_dist",
         {}, dict(stream=True),
+    ))
+    # the CONTROLLED mesh round (control/) — feedback reductions at
+    # global shape, the per-shard activation rescale, the PeerSwap
+    # scatters: both engine families must stay a state fixed point under
+    # an active controller (the adaptive half of the bit-identity
+    # contract)
+    # (the matching fixture graph is built without a CSR export, so its
+    # controlled entry runs without churn re-wiring — the PeerSwap
+    # refresh + churn composition traces on the bucketed entry instead)
+    eps.append(dist_ep(
+        "dist[matching,control]", "dist-matching", "gossip_round_dist",
+        {}, dict(control=True),
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed,control]", "dist-bucketed", "gossip_round_dist",
+        dict(rewire_slots=2, churn_join_prob=0.02, churn_leave_prob=0.002),
+        dict(control=True),
     ))
     # the jitted dist loop entries (donating) — scan/while over shard_map
     eps.append(dist_ep(

@@ -189,6 +189,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stream-hot-frac", type=float, default=0.01, metavar="F")
     p.add_argument("--stream-hot-weight", type=float, default=0.9, metavar="W")
     p.add_argument(
+        "--control", type=float, default=0.0, metavar="TARGET_RATIO",
+        help="adaptive protocol control (tpu_gossip/control/, docs/"
+        "adaptive_control.md): close the fanout feedback loop inside the "
+        "jitted round, defending the declared delivery-ratio target. Per "
+        "round an AIMD policy widens the effective fanout when the "
+        "observed delivery signals fall below TARGET_RATIO (realized "
+        "loss, lagging stream slots) and shrinks it when the duplicate "
+        "rate saturates; in push_pull mode the anti-entropy half runs "
+        "only at-or-below the static --fanout. Runs on every engine from "
+        "a dedicated PRNG stream (controlled local and sharded runs stay "
+        "bit-identical); the summary JSON gains the reliability "
+        "contract block on fixed-horizon runs",
+    )
+    p.add_argument(
+        "--control-bounds", type=str, default="", metavar="LO,HI",
+        help="the policy's fanout bounds (default: 1,2*--fanout — "
+        "clamped to --rewire-slots when churn re-wiring is active). "
+        "--fanout must lie inside; LO,HI = --fanout,--fanout is the "
+        "zero-adjustment controller, bit-identical to the static run",
+    )
+    p.add_argument(
+        "--refresh-every", type=int, default=0, metavar="K",
+        help="PeerSwap neighbor refresh: every K rounds each live "
+        "re-wired peer swaps one fresh-edge slot for a new degree-"
+        "preferential draw (degree-credit bookkeeping preserved) — "
+        "long-lived churned/grown swarms keep their randomness "
+        "guarantees. Needs --control and the re-wiring plane "
+        "(--rewire-slots/--grow); 0 = off",
+    )
+    p.add_argument(
         "--scenario", type=str, default="", metavar="TOML",
         help="chaos scenario schedule (tpu_gossip/faults/, docs/"
         "fault_model.md): time-phased message loss, delivery delay, "
@@ -267,6 +297,10 @@ def main(argv: list[str] | None = None) -> int:
     stream_err = _validate_stream(args)
     if stream_err:
         print(stream_err, file=sys.stderr)
+        return 2
+    control_err = _validate_control(args)
+    if control_err:
+        print(control_err, file=sys.stderr)
         return 2
     if args.profile_round > 0 and args.shard:
         print("--profile-round decomposes the LOCAL round (use "
@@ -388,20 +422,23 @@ def main(argv: list[str] | None = None) -> int:
         np.flatnonzero(np.asarray(exists)) if exists is not None
         else np.arange(graph.n),
     )
+    ctl = _compile_cli_control(args)
     with trace(args.profile):
         if args.remat_every > 0:
-            summary, fin = _run_with_remat(args, cfg, state, scen, grow, strm)
+            summary, fin = _run_with_remat(args, cfg, state, scen, grow,
+                                           strm, ctl)
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
             fin, stats = simulate(state, cfg, args.rounds, plan, args.tail,
-                                  scen, grow, strm)
+                                  scen, grow, strm, ctl)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats,
                                        **_scenario_summary(spec, stats),
-                                       **_stream_summary(args, cfg, stats))
+                                       **_stream_summary(args, cfg, stats),
+                                       **_control_summary(args, cfg, stats))
         else:
-            if scen is None and grow is None:
+            if scen is None and grow is None and ctl is None:
                 result, fin = M.bench_swarm(
                     state, cfg, args.target, args.max_rounds, plan=plan,
                     tail=args.tail,
@@ -414,10 +451,12 @@ def main(argv: list[str] | None = None) -> int:
                     run=lambda st: run_until_coverage(
                         st, cfg, args.target, args.max_rounds, plan=plan,
                         tail=args.tail, scenario=scen, growth=grow,
+                        control=ctl,
                     ),
                 )
             summary = {"summary": True, "mode": args.mode,
                        **_scenario_summary(spec),
+                       **_control_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
@@ -521,6 +560,113 @@ def _validate_stream(args):
                 "recycled before it could possibly cover — raise the TTL "
                 "or the fanout")
     return None
+
+
+def _validate_control(args):
+    """Normalize + reject impossible --control configs; returns an error
+    string (exit 2) or None. Mutates args: settles the bound defaults
+    (args.control_lo / args.control_hi) so every engine path reads one
+    config — the control twin of :func:`_validate_grow`."""
+    if args.control == 0:
+        set_flags = [
+            name for name, dflt in (
+                ("--control-bounds", args.control_bounds == ""),
+                ("--refresh-every", args.refresh_every == 0),
+            ) if not dflt
+        ]
+        if set_flags:
+            return (f"{set_flags[0]} shapes the adaptive-control policy; "
+                    "add --control TARGET_RATIO")
+        return None
+    if not (0.0 < args.control <= 1.0):
+        return (f"--control {args.control} must be a delivery-ratio target "
+                "in (0, 1]")
+    if args.mode == "flood":
+        # flood pushes every edge and has no pull half; re-wiring (the
+        # refresh's substrate) is ignored on every flood path too — a
+        # controller here would move its cursor and certify a contract
+        # while modulating nothing
+        return ("--control modulates the sampled fanout and the "
+                "anti-entropy mix; flood delivery has neither — use "
+                "--mode push or push_pull")
+    if args.profile_round > 0:
+        return ("--profile-round measures the static round's stage "
+                "decomposition; drop --control")
+    rewire = _rewire_slots(args)
+    if args.control_bounds:
+        try:
+            lo_s, hi_s = args.control_bounds.split(",")
+            lo, hi = int(lo_s), int(hi_s)
+        except ValueError:
+            return (f"--control-bounds {args.control_bounds!r} must be "
+                    "LO,HI (two integers)")
+        if lo < 1:
+            return f"--control-bounds lower bound {lo} must be >= 1"
+        if hi < lo:
+            return f"--control-bounds {lo},{hi} has LO > HI"
+        if not (lo <= args.fanout <= hi):
+            return (f"--control-bounds [{lo}, {hi}] must contain --fanout "
+                    f"{args.fanout} — the policy must be able to express "
+                    "the static rate")
+        if rewire > 0 and hi > rewire:
+            return (f"--control-bounds upper bound {hi} exceeds the "
+                    f"re-wiring width --rewire-slots {rewire}: a widened "
+                    "rejoiner would redraw its few fresh edges past their "
+                    "useful multiplicity; raise --rewire-slots or lower HI")
+    else:
+        lo, hi = 1, max(2 * args.fanout, args.fanout)
+        if rewire > 0:
+            hi = max(args.fanout, min(hi, rewire))
+        if rewire > 0 and hi > rewire:
+            return (f"the default control bounds need HI >= --fanout "
+                    f"{args.fanout}, but --rewire-slots is {rewire}; "
+                    "raise --rewire-slots or pass --control-bounds")
+    args.control_lo, args.control_hi = lo, hi
+    if args.refresh_every < 0:
+        return "--refresh-every must be >= 0"
+    if args.refresh_every > 0 and rewire == 0:
+        return ("--refresh-every rides the re-wiring plane "
+                "(rewire_targets) — only re-wired peers carry swappable "
+                "fresh edges; add --rewire-slots (with churn) or --grow")
+    return None
+
+
+def _compile_cli_control(args):
+    """Compile the --control policy — layout-blind, so ONE spec serves
+    every engine path (and survives epoch re-partitions)."""
+    if args.control <= 0:
+        return None
+    from tpu_gossip.control import compile_control
+
+    return compile_control(
+        target_ratio=args.control,
+        fanout=args.fanout,
+        lo=args.control_lo,
+        hi=args.control_hi,
+        refresh_every=args.refresh_every,
+        ttl=args.slot_ttl if args.stream > 0 else 0,
+    )
+
+
+def _control_summary(args, cfg=None, stats=None) -> dict:
+    """Summary-row control fields: the policy config plus, when per-round
+    stats exist, the certified reliability contract block
+    (sim.metrics.reliability_report)."""
+    if args.control <= 0:
+        return {}
+    out = {"control": {
+        "target_ratio": args.control,
+        "bounds": [args.control_lo, args.control_hi],
+        "refresh_every": args.refresh_every,
+    }}
+    if stats is not None:
+        from tpu_gossip.sim import metrics as M
+
+        out["reliability"] = M.reliability_report(
+            stats, target_ratio=args.control, coverage_target=args.target,
+            round_seconds=cfg.round_seconds if cfg is not None else 5.0,
+        )
+    return out
 
 
 def _compile_cli_stream(args, origin_rows):
@@ -720,7 +866,8 @@ def _main_profile_round(args, cfg, state, plan) -> int:
     return 0
 
 
-def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None):
+def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
+                    ctl=None):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
     The first re-materialization pads col_idx to the fixed capacity, so the
@@ -762,10 +909,11 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None):
 
     def run_segment(st, seg, plan):
         if args.rounds > 0:
-            return simulate(st, cfg, seg, plan, args.tail, scen, grow, strm)
+            return simulate(st, cfg, seg, plan, args.tail, scen, grow, strm,
+                            ctl)
         return run_until_coverage(
             st, cfg, args.target, seg, plan=plan, tail=args.tail,
-            scenario=scen, growth=grow, stream=strm,
+            scenario=scen, growth=grow, stream=strm, control=ctl,
         ), None
 
     # warm EVERY shape the timed loop will see, on throwaway clones:
@@ -815,7 +963,8 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None):
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
         return _horizon_summary(
-            args, stats, **extra, **_stream_summary(args, cfg, stats)
+            args, stats, **extra, **_stream_summary(args, cfg, stats),
+            **_control_summary(args, cfg, stats),
         ), state
     rounds = int(state.round)
     summary = {
@@ -857,7 +1006,8 @@ def _horizon_summary(args, stats, **extra):
     }
 
 
-def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
+def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
+                          ctl=None):
     """The mesh epoch loop (SURVEY.md §7.4's full churn lifecycle):
 
         R churned rounds -> fold fresh edges into the CSR
@@ -905,11 +1055,12 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
     seg0 = min(r, total)
     if args.rounds > 0:
         warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans,
-                             scen, None, transport)[0]
+                             scen, None, transport, control=ctl)[0]
     else:
         warm = run_until_coverage_dist(
             clone_state(state), cfg, sg, mesh, args.target, seg0,
             shard_plan=plans, scenario=scen, transport=transport,
+            control=ctl,
         )
     float(warm.coverage(0))
     del warm
@@ -919,12 +1070,12 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
         seg = min(r, total - int(state.round))
         if args.rounds > 0:
             state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans,
-                                         scen, None, transport)
+                                         scen, None, transport, control=ctl)
             stats_parts.append(stats)
         else:
             state = run_until_coverage_dist(
                 state, cfg, sg, mesh, args.target, seg, shard_plan=plans,
-                scenario=scen, transport=transport,
+                scenario=scen, transport=transport, control=ctl,
             )
             if float(state.coverage(0)) >= args.target:
                 break
@@ -956,7 +1107,9 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None):
         ))
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
-        return _horizon_summary(args, stats, **extra), state
+        return _horizon_summary(
+            args, stats, **extra, **_control_summary(args, cfg, stats)
+        ), state
     rounds = int(state.round)
     sim_wall = wall - rebuild_s
     summary = {
@@ -1082,17 +1235,18 @@ def _main_shard_matching(args, rng, spec=None) -> int:
     )
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
     strm = _compile_cli_stream(args, to_rows(np.arange(args.peers)))
+    ctl = _compile_cli_control(args)
     with trace(args.profile):
         if args.rounds > 0:
             if transport is not None:
                 fin, (stats, ici) = simulate_dist(
                     state, cfg, plan, mesh, args.rounds, None, scen, grow,
-                    transport, True, strm,
+                    transport, True, strm, ctl,
                 )
             else:
                 fin, stats = simulate_dist(state, cfg, plan, mesh,
                                            args.rounds, None, scen, grow,
-                                           stream=strm)
+                                           stream=strm, control=ctl)
                 ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -1101,6 +1255,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
                 **_stream_summary(args, cfg, stats),
+                **_control_summary(args, cfg, stats),
             )
         else:
             # the timed region runs WITHOUT the analytic counter so the
@@ -1112,6 +1267,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                 return run_until_coverage_dist(
                     st, cfg, plan, mesh, args.target, args.max_rounds,
                     scenario=scen, growth=grow, transport=transport,
+                    control=ctl,
                 )
 
             r0 = int(state.round)
@@ -1126,12 +1282,13 @@ def _main_shard_matching(args, rng, spec=None) -> int:
 
                 _, (_stats, ici) = simulate_dist(
                     clone_state(state), cfg, plan, mesh, rounds, None, scen,
-                    grow, transport, True,
+                    grow, transport, True, control=ctl,
                 )
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
                        **_scenario_summary(spec),
                        **_transport_summary(args, ici, rounds),
+                       **_control_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
@@ -1205,22 +1362,25 @@ def _main_shard(args, graph, rng, spec=None) -> int:
         node_map=lambda ids: position[np.asarray(ids)],
     )
     strm = _compile_cli_stream(args, position[np.arange(args.peers)])
+    ctl = _compile_cli_control(args)
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
-                args, cfg, state, sg, mesh, plans, scen
+                args, cfg, state, sg, mesh, plans, scen, ctl
             )
             summary.update(_scenario_summary(spec))
             summary.update(_transport_summary(args))
+            summary.update(_control_summary(args))
         elif args.rounds > 0:
             if transport is not None:
                 fin, (stats, ici) = simulate_dist(
                     state, cfg, sg, mesh, args.rounds, plans, scen, grow,
-                    transport, True, strm,
+                    transport, True, strm, ctl,
                 )
             else:
                 fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
-                                           plans, scen, grow, stream=strm)
+                                           plans, scen, grow, stream=strm,
+                                           control=ctl)
                 ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -1229,6 +1389,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
                 **_stream_summary(args, cfg, stats),
+                **_control_summary(args, cfg, stats),
             )
         else:
             # the shared timing harness (warmup, fetch barrier) with the
@@ -1241,7 +1402,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 return run_until_coverage_dist(
                     st, cfg, sg, mesh, args.target, args.max_rounds,
                     shard_plan=plans, scenario=scen, growth=grow,
-                    transport=transport,
+                    transport=transport, control=ctl,
                 )
 
             r0 = int(state.round)
@@ -1256,11 +1417,12 @@ def _main_shard(args, graph, rng, spec=None) -> int:
 
                 _, (_stats, ici) = simulate_dist(
                     clone_state(state), cfg, sg, mesh, rounds, plans, scen,
-                    grow, transport, True,
+                    grow, transport, True, control=ctl,
                 )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
                        **_scenario_summary(spec),
                        **_transport_summary(args, ici, rounds),
+                       **_control_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))

@@ -1,0 +1,27 @@
+"""Adaptive protocol control: coverage-feedback fanout, push↔push-pull
+mix, and the PeerSwap neighbor refresh (docs/adaptive_control.md).
+
+``compile_control`` (control/plan.py) builds the jit-static
+:class:`ControlSpec`; the round hooks live in control/engine.py and run
+inside every engine's jitted round via ``sim.engine.advance_round``.
+"""
+
+from tpu_gossip.control.engine import (
+    CONTROL_STREAM_SALT,
+    ControlTelemetry,
+    RoundControl,
+    apply_control,
+    control_round,
+)
+from tpu_gossip.control.plan import ControlError, ControlSpec, compile_control
+
+__all__ = [
+    "CONTROL_STREAM_SALT",
+    "ControlError",
+    "ControlSpec",
+    "ControlTelemetry",
+    "RoundControl",
+    "compile_control",
+    "control_round",
+    "apply_control",
+]

@@ -1,0 +1,283 @@
+"""In-round adaptive control: the feedback engine's on-device half.
+
+Two hooks, both inside the jitted round and shared by all three local
+engines and both mesh engines so the policy exists once and cannot
+drift:
+
+- :func:`control_round` runs at the TOP of the round: it resolves the
+  state's level cursor (``SwarmState.control_lvl``) against the compiled
+  :class:`~tpu_gossip.control.ControlSpec` into this round's
+  :class:`RoundControl` — the traced effective fanout ``m_eff`` (a value
+  in ``[lo, hi]``) and the traced pull gate. Dissemination consumes it:
+  the exactly-k XLA path draws at the static width ``hi`` and masks
+  columns past ``m_eff`` (zero-adjustment bounds make the mask all-true,
+  so the draws and bits are the uncontrolled ones), and every
+  Bernoulli-per-edge engine (staircase kernel, matching family, bucketed
+  mesh) scales its activation law to ``m_eff/deg`` — same draw shapes,
+  same keys, only the thresholds move, which is what keeps the
+  local ↔ sharded bit-identity contract intact under control.
+- :func:`apply_control` runs as the LAST stage of ``advance_round``: it
+  reads the round's realized feedback — delivered vs duplicate bits
+  (``incoming`` against the pre-round ``seen``), the fault head's
+  realized loss ratio, and the streaming plane's per-slot ages — and
+  moves the level cursor AIMD-style: **additive widen** (+1 level) when
+  the observed delivery signal falls below ``target_ratio`` (loss above
+  the target's tolerance, or a live stream slot past half its TTL still
+  under target coverage), **multiplicative shrink** (level halves) when
+  the duplicate rate saturates (``sat_dup``). It also runs the PeerSwap
+  neighbor refresh: every ``refresh_every`` rounds each live re-wired
+  peer swaps one uniformly-chosen fresh-edge slot for a new
+  degree-preferential endpoint draw, releasing the degree credit of the
+  edge it discards and granting it to the new one — the re-wiring
+  plane's book-balance invariant is preserved exactly (test-pinned).
+
+Every stochastic choice draws from ``fold_in(state.rng,
+CONTROL_STREAM_SALT)`` at GLOBAL shape outside ``shard_map`` — a
+derivation parallel to the protocol's 5-way split and the
+fault/growth/traffic streams, overlapping none of them — so
+``control=None`` (and a zero-adjustment spec) reproduces the
+uncontrolled protocol trajectory bit for bit, and controlled runs stay
+bit-identical local vs sharded across modes × scenarios × growth ×
+stream (tests/sim/test_control.py pins the matrix). The feedback itself
+is integer sums (order-independent under sharding), so the level
+trajectory is bit-exact across engine layouts too.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_gossip.core.streams import CONTROL_STREAM_SALT
+
+__all__ = [
+    "CONTROL_STREAM_SALT",
+    "RoundControl",
+    "ControlTelemetry",
+    "control_round",
+    "apply_control",
+]
+
+
+class RoundControl(NamedTuple):
+    """One round's resolved control decision (consumed by dissemination)."""
+
+    m_eff: jax.Array  # i32 () — effective fanout this round
+    pull_on: jax.Array  # bool () — run the pull half (push_pull mode)
+    lvl: jax.Array  # i32 () — resolved level index into the tables
+    width: int  # static — draw width for exactly-k paths (= spec.hi)
+    # (N,) bool — peers still MISSING some live message's bits, or None
+    # when the needy-pull gate is off (spec.pull_needy). A sated peer's
+    # pull delivers nothing it lacks (every seen bit lives on a leased
+    # slot — expiry clears columns globally), so its request is simply
+    # not issued: delivery-exact, and the late-phase request flood
+    # collapses to the stragglers who actually need it.
+    needy: jax.Array | None
+
+
+class ControlTelemetry(NamedTuple):
+    """Per-round controller counters for RoundStats (all scalar int32)."""
+
+    level: jax.Array  # level that drove THIS round's fanout
+    fanout: jax.Array  # effective fanout this round
+    duplicate: jax.Array  # delivered bits landing on already-seen slots
+    refreshed: jax.Array  # PeerSwap slot swaps applied this round
+
+
+def control_round(spec, state, want_needy: bool = False) -> RoundControl:
+    """Resolve the state's cursor into this round's decision.
+
+    ``want_needy`` (static — the caller passes ``cfg.mode ==
+    "push_pull"``) computes the needy-pull row mask only when a pull half
+    exists to consume it.
+
+    The cursor packs ``level + levels * stress_bit``: the level indexes
+    the bounded fanout/mix tables; the stress bit latches the previous
+    round's under-delivery signal so a stressed run keeps its
+    anti-entropy half regardless of level. The mix's third gate is
+    LAG-FREE feedback read off the state itself: a pull succeeds for a
+    given message with probability ≈ that message's current coverage, so
+    the pull half switches on the round some live lease's coverage
+    passes ``pull_knee`` (and back off once every live message covered —
+    the post-coverage savings regime). A cursor of -1 (``init_swarm`` /
+    pre-control checkpoints) starts at ``spec.start`` — the widest CLEAN
+    level: the epidemic-growth regime, where extra fanout buys coverage
+    speed for near-zero duplicate cost; the AIMD shrink walks the level
+    down as duplicates saturate, and only the under-delivery widening
+    path climbs past the start onto the stress rung. Cursors from a
+    checkpoint saved under different bounds clip into the current table.
+    """
+    levels = spec.levels
+    cursor = jnp.clip(state.control_lvl, 0, 2 * levels - 1)
+    lvl = jnp.where(
+        state.control_lvl < 0, spec.start, cursor % levels
+    ).astype(jnp.int32)
+    stress_bit = jnp.where(
+        state.control_lvl < 0, False, cursor >= levels
+    )
+    # the knee gate, computed on THIS round's state (integer sums —
+    # bit-exact across engine layouts): some live message is past the
+    # coverage knee where pulls start succeeding, yet under target
+    live = state.alive & ~state.declared_dead
+    n_live = jnp.maximum(jnp.sum(live, dtype=jnp.int32), 1)
+    slot_cov = (
+        jnp.sum(state.seen & live[:, None], axis=0, dtype=jnp.int32)
+        .astype(jnp.float32)
+        / n_live.astype(jnp.float32)
+    )
+    knee_gate = jnp.any(
+        (state.slot_lease >= 0)
+        & (slot_cov < spec.target_ratio)
+        & (slot_cov >= spec.pull_knee)
+    )
+    needy = None
+    if spec.pull_needy and want_needy:
+        needy = jnp.any(
+            (state.slot_lease >= 0)[None, :] & ~state.seen, axis=1
+        )
+    return RoundControl(
+        m_eff=spec.fanout_table[lvl],
+        pull_on=spec.pull_table[lvl] | stress_bit | knee_gate,
+        lvl=lvl,
+        width=spec.hi,
+        needy=needy,
+    )
+
+
+def apply_control(
+    spec,
+    rng: jax.Array,
+    rnd: jax.Array,
+    rc: RoundControl,
+    *,
+    incoming: jax.Array,
+    seen_prev: jax.Array,
+    seen: jax.Array,
+    alive: jax.Array,
+    declared_dead: jax.Array,
+    exists: jax.Array,
+    rewired: jax.Array,
+    rewire_targets: jax.Array,
+    degree_credit: jax.Array,
+    row_ptr: jax.Array,
+    col_idx: jax.Array,
+    slot_lease: jax.Array,
+    rewire_slots: int,
+    fstats=None,
+) -> tuple[jax.Array, jax.Array, jax.Array, ControlTelemetry]:
+    """One AIMD level update + the PeerSwap refresh; returns
+    ``(control_lvl, rewire_targets, degree_credit, telemetry)``.
+
+    ``rng`` is the round's ROOT key (``state.rng``) — the control stream
+    derives by ``fold_in`` and consumes nothing of the protocol's 5-way
+    split or the other registered streams. Runs after the fused tail and
+    the churn/growth/stream stages, so the feedback reads the round's
+    FINAL liveness and lease tables and the swap acts on the post-churn,
+    post-growth re-wiring plane. All feedback reductions are integer
+    (order-independent), so the level trajectory is bit-exact across
+    engine layouts; the refresh draws are made every controlled round at
+    full ``(N,)`` shape and masked by the cadence (stream positions
+    depend only on the round — the faults/growth/traffic convention), so
+    cadence edits never shift later rounds' randomness.
+    """
+    levels = spec.levels
+
+    # --- feedback: duplicate saturation -----------------------------------
+    live = alive & ~declared_dead
+    inc_live = incoming & live[:, None]
+    total_inc = jnp.sum(inc_live, dtype=jnp.int32)
+    duplicate = jnp.sum(inc_live & seen_prev, dtype=jnp.int32)
+    dup_rate = duplicate.astype(jnp.float32) / jnp.maximum(
+        total_inc, 1
+    ).astype(jnp.float32)
+    saturated = (total_inc > 0) & (dup_rate >= spec.sat_dup)
+
+    # --- feedback: under-delivery -----------------------------------------
+    # (a) the fault head's realized loss ratio eats into the delivery
+    # budget: widen while the network drops more than the target tolerates
+    under = jnp.zeros((), dtype=bool)
+    if fstats is not None:
+        dropped = fstats.msgs_dropped.astype(jnp.float32)
+        landed = fstats.msgs_delivered.astype(jnp.float32)
+        loss_ratio = dropped / jnp.maximum(dropped + landed, 1.0)
+        under = under | (loss_ratio > (1.0 - spec.target_ratio))
+    # (b) per-slot coverage: every live message (an occupied slot lease —
+    # the single-epidemic seed and every streaming injection lease one)
+    # still under the target's live coverage is an epidemic IN PROGRESS.
+    # The global duplicate rate is dominated by the saturated incumbents,
+    # so an unfloored shrink would starve exactly the messages the
+    # contract judges — the shrink therefore FLOORS at the static
+    # baseline while any live message is uncovered (and a fresh lease
+    # snaps a narrowed controller back up to it); narrowing below base
+    # is purely the POST-COVERAGE savings regime. Under a stream
+    # (``ttl`` > 0), a lease past half its TTL still uncovered is a
+    # message about to miss its window — widen.
+    n_live = jnp.maximum(jnp.sum(live, dtype=jnp.int32), 1)
+    slot_cov = (
+        jnp.sum(seen & live[:, None], axis=0, dtype=jnp.int32).astype(
+            jnp.float32
+        )
+        / n_live.astype(jnp.float32)
+    )
+    uncovered = (slot_lease >= 0) & (slot_cov < spec.target_ratio)
+    floor = jnp.where(jnp.any(uncovered), spec.base_idx, 0).astype(jnp.int32)
+    if spec.ttl > 0:
+        age = rnd - slot_lease
+        under = under | jnp.any(uncovered & (2 * age >= spec.ttl))
+
+    # --- AIMD: additive widen beats multiplicative shrink -----------------
+    lvl = jnp.where(
+        under,
+        jnp.minimum(rc.lvl + 1, levels - 1),
+        jnp.where(saturated, rc.lvl // 2, rc.lvl),
+    )
+    lvl = jnp.clip(lvl, floor, levels - 1).astype(jnp.int32)
+    # the stress bit: a round widened by under-delivery keeps its
+    # anti-entropy half next round regardless of level (control_round's
+    # knee gate handles the lag-free coverage half of the mix)
+    cursor = (lvl + levels * under.astype(jnp.int32)).astype(jnp.int32)
+
+    # --- PeerSwap neighbor refresh (rides the re-wiring plane) ------------
+    refreshed = jnp.zeros((), dtype=jnp.int32)
+    if spec.refresh_every > 0 and rewire_slots > 0 and col_idx.shape[0] > 1:
+        n = exists.shape[0]
+        k_ctl = jax.random.fold_in(rng, CONTROL_STREAM_SALT)
+        k_slot, k_tgt = jax.random.split(k_ctl)
+        due = (rnd % spec.refresh_every) == 0
+        # one uniformly-chosen fresh-edge slot per row, one fresh
+        # degree-preferential endpoint draw (the churn-join law: a uniform
+        # index into the CSR endpoint list over the REAL edge span)
+        slot = jax.random.randint(k_slot, (n,), 0, rewire_slots)
+        e_real = jnp.maximum(row_ptr[-1], 1)
+        draws = col_idx[jax.random.randint(k_tgt, (n,), 0, e_real)]
+        rows_idx = jnp.arange(n, dtype=jnp.int32)
+        self_draw = draws == rows_idx.astype(draws.dtype)
+        new_tgt = jnp.where(
+            exists[jnp.clip(draws, 0, n - 1)] & ~self_draw, draws, -1
+        ).astype(rewire_targets.dtype)
+        act = due & rewired & alive & exists
+        old = rewire_targets[rows_idx, slot]
+        # degree-credit bookkeeping: the discarded edge's credit is
+        # RELEASED, the new edge's GRANTED — sum(credit) keeps tracking
+        # the stored fresh targets of re-wired rows exactly (the fold
+        # invariant rematerialize_rewired leans on)
+        degree_credit = degree_credit.at[
+            jnp.where(act & (old >= 0), old, n)
+        ].add(-1, mode="drop")
+        degree_credit = degree_credit.at[
+            jnp.where(act & (new_tgt >= 0), new_tgt, n)
+        ].add(1, mode="drop")
+        rewire_targets = rewire_targets.at[rows_idx, slot].set(
+            jnp.where(act, new_tgt, old)
+        )
+        refreshed = jnp.sum(act, dtype=jnp.int32)
+
+    telem = ControlTelemetry(
+        level=rc.lvl,
+        fanout=rc.m_eff,
+        duplicate=duplicate,
+        refreshed=refreshed,
+    )
+    return cursor, rewire_targets, degree_credit, telem

@@ -1,0 +1,204 @@
+"""Adaptive protocol control plans: a bounded fanout/mix policy, compiled
+host-side.
+
+Every engine before this plane pushed with a STATIC fanout ``m`` every
+round. *Push is Fast on Sparse Random Graphs* (PAPERS.md) shows that
+overpays in the early and late epidemic phases — the useful ``m`` is a
+function of the epidemic's phase — and *Reliable Probabilistic Gossip
+over Large-Scale Random Topologies* (PAPERS.md) shows it under-delivers
+exactly when loss and partitions bite. A :class:`ControlSpec` is the
+jit-static description of the feedback policy that closes that loop —
+the control twin of :class:`~tpu_gossip.faults.CompiledScenario`,
+:class:`~tpu_gossip.growth.CompiledGrowth` and
+:class:`~tpu_gossip.traffic.CompiledStream`:
+
+- **fanout table** — the policy is a bounded TABLE of effective fanouts
+  ``[lo, lo+1, .., hi]``; the state carries one int32 cursor
+  (``SwarmState.control_lvl``) indexing it. Per round the AIMD-style
+  update (control/engine.py) widens the level when the observed delivery
+  signals fall below ``target_ratio`` (loss bites, stream slots lag) and
+  shrinks it multiplicatively when the duplicate rate saturates — the
+  late-epidemic regime where every push is a re-delivery.
+- **push↔push-pull mix** — in ``push_pull`` mode the pull half costs one
+  request per receptive peer per round regardless of coverage, and a
+  pull succeeds for a given message with probability ≈ that message's
+  current coverage — worthless during the pure ramp, decisive on the
+  saturated tail. The mix is therefore THREE gates OR-ed: the level
+  table keeps anti-entropy on at-or-below the static baseline fanout (so
+  the zero-adjustment spec is exactly the uncontrolled push_pull); a
+  lag-free knee gate switches it on while some live message's coverage
+  sits in ``[pull_knee, target)`` (``pull_knee`` > 0 makes the opening
+  ramp pure push); and the cursor's stress bit latches it on after any
+  under-delivery round. Orthogonally, the **needy-pull** gate
+  (``pull_needy``, on by default for active bounds) stops SATED peers —
+  nothing live missing — from issuing their request at all: every seen
+  bit lives on a leased slot, so the skipped pull could not have
+  delivered anything, and the late-phase request flood collapses to the
+  stragglers who need it. The table's one extra **stress rung** — the
+  widest fanout WITH the pull half on — sits above the clean levels and
+  is reachable only by the under-delivery widening path. The clean-start
+  cursor begins on the widest clean level, one below the rung.
+- **PeerSwap neighbor refresh** — every ``refresh_every`` rounds each
+  live re-wired peer swaps one of its fresh-edge slots for a new
+  degree-preferential endpoint draw (PAPERS.md's PeerSwap: continuous
+  randomized neighbor exchange keeps a long-lived overlay's randomness
+  guarantees). The swap rides the EXISTING re-wiring plane —
+  ``rewire_targets`` entries are replaced in place with degree-credit
+  bookkeeping preserved — and draws from the registered
+  ``CONTROL_STREAM_SALT`` stream at global shape, so controlled runs
+  stay bit-identical local vs sharded.
+
+The spec carries NO per-node tables — it is layout-blind by
+construction, so one compile serves every engine (and survives an epoch
+re-partition, unlike scenario node masks or growth admit rows).
+``control=None`` compiles the whole stage out and a zero-adjustment
+spec (``lo == hi == fanout``, ``refresh_every=0``) reproduces the
+uncontrolled protocol trajectory bit for bit (both test-pinned,
+tests/sim/test_control.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+__all__ = [
+    "ControlError",
+    "ControlSpec",
+    "compile_control",
+]
+
+
+class ControlError(ValueError):
+    """A control config that cannot mean what it says (compile time)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ControlSpec:
+    """A feedback-control policy compiled to device tables.
+
+    Traced leaves carry the bounded policy tables and thresholds; static
+    fields decide trace structure (table length, draw width, refresh
+    cadence) — one compile serves the whole run on every engine. The
+    schedule cursor is ``SwarmState.control_lvl`` (int32 scalar, -1 =
+    uninitialized: the first controlled round starts at the WIDEST
+    level, the epidemic-growth regime), so mid-run checkpoints resume
+    the policy bit-exactly with zero host bookkeeping.
+    """
+
+    fanout_table: jax.Array  # int32 (L,) — effective fanout per level
+    pull_table: jax.Array  # bool (L,) — run the pull half at this level
+    target_ratio: jax.Array  # f32 () — the declared delivery-ratio target
+    sat_dup: jax.Array  # f32 () — duplicate-rate saturation threshold
+    pull_knee: jax.Array  # f32 () — slot coverage where anti-entropy pays
+    lo: int = dataclasses.field(metadata=dict(static=True))
+    hi: int = dataclasses.field(metadata=dict(static=True))
+    base: int = dataclasses.field(metadata=dict(static=True))
+    levels: int = dataclasses.field(metadata=dict(static=True))
+    start: int = dataclasses.field(metadata=dict(static=True))
+    refresh_every: int = dataclasses.field(metadata=dict(static=True))
+    ttl: int = dataclasses.field(metadata=dict(static=True))
+    pull_needy: bool = dataclasses.field(
+        default=False, metadata=dict(static=True)
+    )
+
+    @property
+    def base_idx(self) -> int:
+        """Level index of the static baseline fanout (the shrink floor
+        while any live message is still under target)."""
+        return self.base - self.lo
+
+
+def compile_control(
+    *,
+    target_ratio: float,
+    fanout: int,
+    lo: int | None = None,
+    hi: int | None = None,
+    refresh_every: int = 0,
+    ttl: int = 0,
+    sat_dup: float = 0.8,
+    pull_knee: float = 0.0,
+    pull_needy: bool | None = None,
+) -> ControlSpec:
+    """Compile a feedback-control policy (one spec serves every engine).
+
+    ``fanout`` is the config's STATIC baseline ``m`` — it must lie inside
+    ``[lo, hi]`` so the policy can always express the uncontrolled rate
+    (and so ``lo == hi == fanout`` is the exact zero-adjustment spec).
+    ``ttl`` is the streaming slot TTL when a stream rides the run (0:
+    no stream — the per-slot lag signal compiles out). ``refresh_every``
+    is the PeerSwap cadence in rounds (0: off). ``pull_needy`` gates the
+    needy-pull saving (push_pull mode: a peer already holding every live
+    message's bits does not issue its anti-entropy request — delivery-
+    exact, only the request/answer billing moves); it defaults to ON
+    exactly when the bounds are not pinned, so the zero-adjustment spec
+    stays bit-identical to the uncontrolled run with no extra flags.
+    Validates as a precondition: impossible policies are config errors
+    before anything traces.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not (0.0 < target_ratio <= 1.0):
+        raise ControlError(
+            f"target_ratio {target_ratio} outside (0, 1] — it is the "
+            "delivery-ratio the controller defends"
+        )
+    if not (0.0 < sat_dup <= 1.0):
+        raise ControlError(f"sat_dup {sat_dup} outside (0, 1]")
+    if not (0.0 <= pull_knee <= 1.0):
+        raise ControlError(f"pull_knee {pull_knee} outside [0, 1]")
+    if lo is None:
+        lo = 1
+    if hi is None:
+        hi = max(2 * fanout, fanout)
+    if lo < 1:
+        raise ControlError(f"fanout bound lo={lo} must be >= 1")
+    if hi < lo:
+        raise ControlError(f"fanout bounds lo={lo} > hi={hi}")
+    if not (lo <= fanout <= hi):
+        raise ControlError(
+            f"static fanout {fanout} outside the control bounds "
+            f"[{lo}, {hi}] — the policy must be able to express the "
+            "uncontrolled rate"
+        )
+    if refresh_every < 0:
+        raise ControlError(f"refresh_every {refresh_every} must be >= 0")
+    if ttl < 0:
+        raise ControlError(f"ttl {ttl} must be >= 0")
+    clean = np.arange(lo, hi + 1, dtype=np.int32)
+    # the mix rule: anti-entropy pulls run at-or-below the baseline (the
+    # saturated regime); the widened CLEAN levels are pure push. With
+    # lo == hi == fanout every level keeps the pull half on — the
+    # zero-adjustment identity.
+    pull = clean <= fanout
+    if hi > fanout:
+        # the stress rung: widest fanout WITH anti-entropy, reachable only
+        # by under-delivery widening past the clean-start level
+        table = np.concatenate([clean, np.asarray([hi], dtype=np.int32)])
+        pull = np.concatenate([pull, np.asarray([True])])
+        start = len(clean) - 1
+    else:
+        table = clean
+        start = len(clean) - 1
+    return ControlSpec(
+        fanout_table=jnp.asarray(table),
+        pull_table=jnp.asarray(pull),
+        target_ratio=jnp.asarray(target_ratio, dtype=jnp.float32),
+        sat_dup=jnp.asarray(sat_dup, dtype=jnp.float32),
+        pull_knee=jnp.asarray(pull_knee, dtype=jnp.float32),
+        lo=int(lo),
+        hi=int(hi),
+        base=int(fanout),
+        levels=int(len(table)),
+        start=int(start),
+        refresh_every=int(refresh_every),
+        ttl=int(ttl),
+        pull_needy=bool(
+            (lo, hi) != (fanout, fanout) if pull_needy is None
+            else pull_needy
+        ),
+    )

@@ -165,6 +165,15 @@ class SwarmState:
     # predate the field load with every slot free except those
     # ``init_swarm`` seeded (docs/streaming_plane.md).
     slot_lease: jax.Array  # int32 (M,)
+    # adaptive-control cursor (control/): the level index into the
+    # compiled policy's bounded fanout table — -1 = uninitialized (the
+    # first controlled round starts at the widest level). Like
+    # ``slot_lease`` this is the checkpointable CONTROL CURSOR: a
+    # mid-run checkpoint resumes the policy bit-exactly under the same
+    # ControlSpec. The no-control round path carries it untouched
+    # (an uncontrolled run never pays for it); checkpoints that predate
+    # the field load with it -1.
+    control_lvl: jax.Array  # int32 () scalar
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -225,10 +234,11 @@ def load_swarm(path) -> SwarmState:
             if f"prngkey_{f.name}" in data:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
             elif (
-                f.name in ("fault_held", "slot_lease")
+                f.name in ("fault_held", "slot_lease", "control_lvl")
                 or f.name in _GROWTH_FIELDS
             ) and f"field_{f.name}" not in data:
-                continue  # pre-scenario/growth/stream checkpoint: filled below
+                continue  # pre-scenario/growth/stream/control checkpoint:
+                # filled below
             else:
                 kwargs[f.name] = jnp.asarray(data[f"field_{f.name}"])
         if "fault_held" not in kwargs:
@@ -237,6 +247,10 @@ def load_swarm(path) -> SwarmState:
             kwargs.update(_zero_registry(kwargs["exists"]))
         if "slot_lease" not in kwargs:
             kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
+        if "control_lvl" not in kwargs:
+            # pre-control checkpoint: uninitialized cursor (a controller
+            # attached on resume starts at its widest level)
+            kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
     else:  # legacy positional layout
         for i, name in enumerate(_V1_FIELDS):
             if f"key_{i}" in data:
@@ -261,6 +275,7 @@ def load_swarm(path) -> SwarmState:
         kwargs["fault_held"] = jnp.zeros((n, m), dtype=bool)
         kwargs.update(_zero_registry(kwargs["exists"]))
         kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
+        kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
     return SwarmState(**kwargs)
 
 
@@ -443,6 +458,7 @@ def init_swarm(
         admitted_by=jnp.full((n,), -1, dtype=jnp.int32),
         degree_credit=jnp.zeros((n,), dtype=jnp.int32),
         slot_lease=slot_lease,
+        control_lvl=jnp.asarray(-1, dtype=jnp.int32),
         rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

@@ -32,6 +32,7 @@ __all__ = [
     "FAULT_STREAM_SALT",
     "GROWTH_STREAM_SALT",
     "TRAFFIC_STREAM_SALT",
+    "CONTROL_STREAM_SALT",
     "register_stream",
     "registered_salts",
 ]
@@ -77,12 +78,14 @@ def registered_salts() -> dict[int, str]:
 
 
 # the canonical stream map (keep docs/fault_model.md + docs/growth_engine.md
-# + docs/streaming_plane.md tables in sync):
+# + docs/streaming_plane.md + docs/adaptive_control.md tables in sync):
 #
 #   stream   salt         consumer                         draws
 #   fault    0x5CE7A510   faults/inject.py (scenarios)     loss/delay/blackout
 #   growth   0x9087A110   growth/engine.py (admission)     Gumbel-top-k targets
 #   traffic  0x7AFF1C00   traffic/engine.py (injection)    arrivals/origins/slots
+#   control  0xC0274201   control/engine.py (PeerSwap)     neighbor-refresh swaps
 FAULT_STREAM_SALT = register_stream("fault", 0x5CE7A510)
 GROWTH_STREAM_SALT = register_stream("growth", 0x9087A110)
 TRAFFIC_STREAM_SALT = register_stream("traffic", 0x7AFF1C00)
+CONTROL_STREAM_SALT = register_stream("control", 0xC0274201)

@@ -120,9 +120,18 @@ def _matching_exchange_dist(
     do_pull: bool = False,
     interpret: bool | None = None,
     transport=None,
+    fanout: jax.Array | None = None,
+    pull_gate: jax.Array | None = None,
+    pull_needy_rows: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Sampled matching delivery on the mesh — the contract (and the bits)
     of ``kernels.matching.matching_sampled``.
+
+    ``fanout``/``pull_gate`` are the adaptive controller's round decision
+    (control/): the push gate recomputes from the SAME degree tables with
+    the traced fanout (bit-identical to the local kernel's recomputation
+    on the same key), the pull activation masks on the replicated gate —
+    so controlled mesh rounds keep this engine's bit-identity contract.
 
     Packing, push gates, and the final receptive row mask are elementwise
     over already-sharded arrays, so they run OUTSIDE ``shard_map`` (the
@@ -161,7 +170,8 @@ def _matching_exchange_dist(
     # edge activation drawn once, global shape, shared across word groups —
     # bit-identical to matching_sampled's draws on the same key
     active_p = (
-        jax.random.bits(k_push, shape, jnp.uint32) < plan.push_threshold()
+        jax.random.bits(k_push, shape, jnp.uint32)
+        < plan.push_threshold(fanout)
         if do_push
         else None
     )
@@ -173,6 +183,8 @@ def _matching_exchange_dist(
         plan.local_classes, plan.per_rows, plan.n_blk,
     )
     has_rec = receptive_rows is not None
+    has_pull_gate = do_pull and pull_gate is not None
+    has_needy = do_pull and pull_needy_rows is not None
     operands = [tx_words]
     if ans_words is not None:
         operands.append(ans_words)
@@ -182,9 +194,16 @@ def _matching_exchange_dist(
         operands += [bits_q, plan.valid, plan.deg_real]
         if has_rec:
             operands.append(receptive_rows)
+        if has_needy:
+            operands.append(pull_needy_rows)
     operands += list(plan.lanes) + [plan.m3] + list(plan.lanes_inv)
     k_stages = len(plan.lanes)
     in_specs = [P(AXIS)] * len(operands)
+    if has_pull_gate:
+        # the controller's pull gate is a replicated scalar decision —
+        # every shard reads the same value (like the transport hub tables)
+        operands.append(jnp.reshape(pull_gate, (1,)))
+        in_specs.append(P())
     if transport is not None:
         operands.append(transport.leaf_slots)
         in_specs.append(P(AXIS))
@@ -212,9 +231,11 @@ def _matching_exchange_dist(
         if do_pull:
             bq, valid_blk, deg_real_blk = next(it), next(it), next(it)
             rec_blk = next(it) if has_rec else None
+            needy_blk = next(it) if has_needy else None
         lane_blks = [next(it) for _ in range(k_stages)]
         m3_blk = next(it)
         lanes_inv_blks = [next(it) for _ in range(k_stages)]
+        pg_blk = next(it) if has_pull_gate else None
         if transport is not None:
             leaf_blk = next(it)  # (per_rows, 128) bool
             hub_blks = [next(it) for _ in range(len(transport.hub_tables))]
@@ -259,6 +280,18 @@ def _matching_exchange_dist(
                 jnp.uint32(0),
             )
             act_q = bq < thresh_q
+            if pg_blk is not None:
+                act_q = act_q & pg_blk[0]
+            if needy_blk is not None:
+                # needy-pull gate (control/): a sated puller issues no
+                # request — same class-expand mask the local kernel
+                # applies, so the bits stay identical
+                act_q = act_q & (
+                    expand_classes(
+                        needy_blk.astype(jnp.int32), local_classes, per_rows
+                    )
+                    > 0
+                )
             pull_bill = act_q.astype(jnp.int32)
             if rec_blk is not None:
                 rec_slots = (
@@ -413,6 +446,7 @@ def _disseminate_matching_dist(
     k_push: jax.Array,
     k_pull: jax.Array,
     transport=None,
+    rctl=None,
 ) -> tuple[jax.Array, jax.Array]:
     """The sharded matching dissemination core; returns (incoming, msgs).
 
@@ -441,6 +475,9 @@ def _disseminate_matching_dist(
             receptive_rows=rec_rows,
             do_push=True, do_pull=(cfg.mode == "push_pull"),
             transport=transport,
+            fanout=None if rctl is None else rctl.m_eff,
+            pull_gate=None if rctl is None else rctl.pull_on,
+            pull_needy_rows=None if rctl is None else rctl.needy,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + msgs
@@ -448,7 +485,7 @@ def _disseminate_matching_dist(
             fresh_inc, fresh_msgs = fresh_rewire_traffic(
                 state, cfg, transmit, state.seen & transmitter,
                 receptive.any(-1), k_rw_push, k_rw_pull,
-                do_pull=(cfg.mode == "push_pull"),
+                do_pull=(cfg.mode == "push_pull"), rctl=rctl,
             )
             incoming = incoming | fresh_inc
             msgs_sent = msgs_sent + fresh_msgs
@@ -473,6 +510,7 @@ def gossip_round_dist_matching(
     transport=None,
     collect_ici: bool = False,
     stream=None,
+    control=None,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -521,15 +559,22 @@ def gossip_round_dist_matching(
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = compute_roles(state)
     transmit = transmit_bitmap(state, cfg, transmitter)
+    rctl = None
+    if control is not None:
+        from tpu_gossip.control.engine import control_round
+
+        rctl = control_round(control, state,
+                             want_needy=cfg.mode == "push_pull")
 
     if scenario is None:
         incoming, msgs_sent = _disseminate_matching_dist(
             state, cfg, plan, mesh, transmit, transmitter, receptive,
-            k_push, k_pull, transport,
+            k_push, k_pull, transport, rctl,
         )
         out = advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
             k_join, receptive, growth=growth, stream=stream,
+            control=control, rctl=rctl,
         )
         if not collect_ici:
             return out
@@ -539,7 +584,8 @@ def gossip_round_dist_matching(
 
     def deliver(tx, tr, rc, k_dpush, k_dpull):
         return _disseminate_matching_dist(
-            state, cfg, plan, mesh, tx, tr, rc, k_dpush, k_dpull, transport
+            state, cfg, plan, mesh, tx, tr, rc, k_dpush, k_dpull, transport,
+            rctl,
         )
 
     incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
@@ -550,6 +596,7 @@ def gossip_round_dist_matching(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth, stream=stream,
+        control=control, rctl=rctl,
     )
     if not collect_ici:
         return out

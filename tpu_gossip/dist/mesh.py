@@ -516,6 +516,7 @@ def _exchange(
     blocked_rows: jax.Array | None = None,
     shard_plan: ShardPlans | None = None,
     transport=None,
+    rctl=None,
 ) -> tuple[jax.Array, jax.Array]:
     """One bucketed all_to_all fan-out; returns (incoming, msgs_per_shard).
 
@@ -542,6 +543,13 @@ def _exchange(
     whenever the header proves the budget would overflow. Everything
     downstream of the collective (stale filter, billing, both receive
     paths) is shared, so sparse rounds stay bit-identical.
+
+    ``rctl`` (a :class:`~tpu_gossip.control.RoundControl`) substitutes
+    the controller's traced effective fanout into the push activation
+    law ``B(m_eff/deg)`` and masks the pull activation on the replicated
+    pull gate — same draw shapes, same keys, only thresholds move, so a
+    zero-adjustment controller reproduces the uncontrolled exchange bit
+    for bit. The decision rides one tiny replicated (S, 2) operand.
     """
     from tpu_gossip.dist.transport import (
         compact_index, gather_compact, occupancy_counts, scatter_compact,
@@ -567,12 +575,25 @@ def _exchange(
         shard_plan.tile_block, shard_plan.first_visit,
         shard_plan.offs, shard_plan.window_idx,
     )
+    ctl_args = () if rctl is None else (
+        # the round decision, replicated per shard like the key array:
+        # column 0 the effective fanout, column 1 the pull gate
+        jnp.broadcast_to(
+            jnp.stack([rctl.m_eff, rctl.pull_on.astype(jnp.int32)]), (s, 2)
+        ),
+    )
     merged = activation == "push_pull"
+    # the needy-pull row mask rides the merged transport as one more
+    # peer-sharded operand (the split pull path folds it into
+    # blocked_rows instead — see _disseminate_bucketed)
+    has_needy = merged and rctl is not None and rctl.needy is not None
+    if has_needy:
+        ctl_args = (*ctl_args, rctl.needy)
 
     @functools.partial(
         shard_map_compat,
         mesh=mesh,
-        in_specs=(P(AXIS),) * (8 + len(plan_args)),
+        in_specs=(P(AXIS),) * (8 + len(plan_args) + len(ctl_args)),
         out_specs=(P(AXIS), P(AXIS)),
         # the kernel path launches pallas_call with shard-varying prefetch
         # tables, which the varying-axes checker cannot type (see _launch);
@@ -581,7 +602,16 @@ def _exchange(
         check_vma=shard_plan is None and not sparse_on,
     )
     def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, src_deg, key_blk,
-           blocked_blk, *plan_blks):
+           blocked_blk, *rest):
+        plan_blks = rest[: len(plan_args)]
+        needy_blk = rest[-1] if has_needy else None
+        if rctl is not None:
+            ctl_blk = rest[len(plan_args)]
+            f_eff = ctl_blk[0, 0]
+            pull_g = ctl_blk[0, 1] > 0
+        else:
+            f_eff = fanout
+            pull_g = None
         send_src, recv_dst = send_src[0], recv_dst[0]  # (S, B)
         valid, dst_deg, src_deg = valid[0], dst_deg[0], src_deg[0]
         # pack ONCE at node granularity, then ONE per-edge gather of G int32
@@ -599,23 +629,27 @@ def _exchange(
             # Bernoulli k/deg(src) per out-edge ≡ fanout-k sampling with
             # static shapes (expected k pushes per transmitting peer);
             # src_deg is a static bucket table, no gather
-            p = fanout / jnp.maximum(src_deg, 1)
+            p = f_eff / jnp.maximum(src_deg, 1)
             active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
             payload = jnp.where(active[:, :, None], vals, 0)
         elif activation == "pull":
             p = 1.0 / jnp.maximum(dst_deg, 1)
             active = valid & (jax.random.uniform(key_blk[0], (s, b)) < p)
+            if pull_g is not None:
+                active = active & pull_g
             payload = jnp.where(active[:, :, None], vals, 0)
         else:  # merged push_pull: ONE transport for both directions
             kp, kq = jax.random.split(key_blk[0])
             act_p = valid & (
                 jax.random.uniform(kp, (s, b))
-                < fanout / jnp.maximum(src_deg, 1)
+                < f_eff / jnp.maximum(src_deg, 1)
             )
             act_q = valid & (
                 jax.random.uniform(kq, (s, b))
                 < 1.0 / jnp.maximum(dst_deg, 1)
             )
+            if pull_g is not None:
+                act_q = act_q & pull_g
             payload = jnp.where((act_p | act_q)[:, :, None], vals, 0)
             # per-direction billing rides two word bits alongside the words
             acts = act_p.astype(jnp.int32) | (act_q.astype(jnp.int32) << 1)
@@ -672,6 +706,12 @@ def _exchange(
         if merged:
             mask_p = -(acts_r & 1)  # 0 or all-ones
             mask_q = -((acts_r >> 1) & 1)
+            if needy_blk is not None:
+                # needy-pull (control/): a sated puller issued no request,
+                # so its edges' pull direction ships (and bills) nothing —
+                # the same receiver-side filter the stale-edge mask uses.
+                # Words shipped for the PUSH direction are untouched.
+                mask_q = jnp.where(needy_blk[recv_dst], mask_q, 0)
             msgs = jnp.sum(
                 pc(received & mask_p[:, :, None])
                 + pc(received & mask_q[:, :, None]),
@@ -708,7 +748,7 @@ def _exchange(
 
     return ex(
         transmit, sg.send_src, sg.recv_dst, sg.send_valid, sg.send_dst_deg,
-        sg.send_src_deg, keys, blocked_rows, *plan_args,
+        sg.send_src_deg, keys, blocked_rows, *plan_args, *ctl_args,
     )
 
 
@@ -724,6 +764,7 @@ def _disseminate_bucketed(
     k_push: jax.Array,
     k_pull: jax.Array,
     transport=None,
+    rctl=None,
 ) -> tuple[jax.Array, jax.Array]:
     """The bucketed engine's dissemination core; returns (incoming, msgs).
 
@@ -762,37 +803,56 @@ def _disseminate_bucketed(
         inc, msgs = _exchange(
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "push_pull", cfg.fanout, blocked_rows=blocked,
-            shard_plan=shard_plan, transport=transport,
+            shard_plan=shard_plan, transport=transport, rctl=rctl,
         )
         incoming = incoming | inc
         # delivered bits + one request per pulling peer, mirroring the local
         # engine's accounting (sim/engine.py _disseminate_local); rewired
-        # pullers are billed in fresh_rewire_traffic instead, not twice
+        # pullers are billed in fresh_rewire_traffic instead, not twice;
+        # a control-gated pull half bills no requests at all
         pulls = (sg.deg > 0) & receptive.any(-1)
         if rewiring:
             pulls = pulls & ~state.rewired
-        msgs_sent = msgs_sent + jnp.sum(msgs) + jnp.sum(pulls, dtype=jnp.int32)
+        if rctl is not None and rctl.needy is not None:
+            pulls = pulls & rctl.needy
+        n_pulls = jnp.sum(pulls, dtype=jnp.int32)
+        if rctl is not None:
+            n_pulls = jnp.where(rctl.pull_on, n_pulls, 0)
+        msgs_sent = msgs_sent + jnp.sum(msgs) + n_pulls
     if cfg.mode in ("push", "push_pull") and not merged_pp:
         inc, msgs = _exchange(
             # graftlint: disable=key-linearity -- exclusive with the merged_pp arm at trace time (static cfg.mode dispatch): one split(k_push) per trace
             static_tx, sg, jax.random.split(k_push, sg.n_shards), mesh,
             "push", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
-            transport=transport,
+            transport=transport, rctl=rctl,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + jnp.sum(msgs)
     if cfg.mode == "push_pull" and not merged_pp:
         static_answer = answer & ~state.rewired[:, None] if rewiring else answer
+        # needy-pull (control/): a sated puller issues no request — its
+        # rows fold into the pull exchange's receiver-side filter (the
+        # stale-edge mechanism), dropping delivery and billing together
+        pull_blocked = blocked
+        if rctl is not None and rctl.needy is not None:
+            pull_blocked = (
+                ~rctl.needy if blocked is None else blocked | ~rctl.needy
+            )
         inc, msgs = _exchange(
             static_answer, sg, jax.random.split(k_pull, sg.n_shards), mesh,
-            "pull", cfg.fanout, blocked_rows=blocked, shard_plan=shard_plan,
-            transport=transport,
+            "pull", cfg.fanout, blocked_rows=pull_blocked,
+            shard_plan=shard_plan, transport=transport, rctl=rctl,
         )
         incoming = incoming | inc
         pulls = (sg.deg > 0) & receptive.any(-1)
         if rewiring:
             pulls = pulls & ~state.rewired
-        msgs_sent = msgs_sent + jnp.sum(msgs) + jnp.sum(pulls, dtype=jnp.int32)
+        if rctl is not None and rctl.needy is not None:
+            pulls = pulls & rctl.needy
+        n_pulls = jnp.sum(pulls, dtype=jnp.int32)
+        if rctl is not None:
+            n_pulls = jnp.where(rctl.pull_on, n_pulls, 0)
+        msgs_sent = msgs_sent + jnp.sum(msgs) + n_pulls
     if cfg.mode == "flood":
         inc, msgs = _exchange(
             # graftlint: disable=key-linearity -- flood excludes both push arms above at trace time; one split(k_push) per trace
@@ -805,7 +865,7 @@ def _disseminate_bucketed(
     if rewiring:
         inc, msgs = fresh_rewire_traffic(
             state, cfg, transmit, answer, receptive.any(-1), k_rw_push, k_rw_pull,
-            do_pull=(cfg.mode == "push_pull"),
+            do_pull=(cfg.mode == "push_pull"), rctl=rctl,
         )
         incoming = incoming | inc
         msgs_sent = msgs_sent + msgs
@@ -823,6 +883,7 @@ def gossip_round_dist(
     transport=None,
     collect_ici: bool = False,
     stream=None,
+    control=None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -852,7 +913,9 @@ def gossip_round_dist(
     IciRound`). ``stream`` (traffic/) runs the streaming serving stage
     through the shared ``advance_round`` with the same
     global-shape-draw guarantee — loaded swarms keep each engine
-    family's parity contract."""
+    family's parity contract. ``control`` (control/) closes the
+    adaptive-fanout feedback loop through the shared stage with the same
+    guarantee — controlled swarms keep it too."""
     from tpu_gossip.core.matching_topology import MatchingPlan
 
     if isinstance(sg, MatchingPlan):
@@ -866,7 +929,7 @@ def gossip_round_dist(
                                           scenario=scenario, growth=growth,
                                           transport=transport,
                                           collect_ici=collect_ici,
-                                          stream=stream)
+                                          stream=stream, control=control)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -877,14 +940,21 @@ def gossip_round_dist(
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = compute_roles(state)
     transmit = transmit_bitmap(state, cfg, transmitter)
+    rctl = None
+    if control is not None:
+        from tpu_gossip.control.engine import control_round
+
+        rctl = control_round(control, state,
+                             want_needy=cfg.mode == "push_pull")
     if scenario is None:
         incoming, msgs_sent = _disseminate_bucketed(
             state, cfg, sg, mesh, shard_plan, transmit, transmitter,
-            receptive, k_push, k_pull, transport,
+            receptive, k_push, k_pull, transport, rctl,
         )
         out = advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
             k_join, receptive, growth=growth, stream=stream,
+            control=control, rctl=rctl,
         )
         if not collect_ici:
             return out
@@ -895,7 +965,7 @@ def gossip_round_dist(
     def deliver(tx, tr, rc, k_dpush, k_dpull):
         return _disseminate_bucketed(
             state, cfg, sg, mesh, shard_plan, tx, tr, rc, k_dpush, k_dpull,
-            transport,
+            transport, rctl,
         )
 
     incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
@@ -906,6 +976,7 @@ def gossip_round_dist(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, faults=rf, churn_faults=scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth, stream=stream,
+        control=control, rctl=rctl,
     )
     if not collect_ici:
         return out
@@ -954,6 +1025,7 @@ def simulate_dist(
     transport=None,
     collect_ici: bool = False,
     stream=None,
+    control=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -973,7 +1045,7 @@ def simulate_dist(
     def body(carry, _):
         out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
                                 scenario, growth, transport, collect_ici,
-                                stream)
+                                stream, control)
         if collect_ici:
             nxt, stats, ici = out
             return nxt, (stats, ici)
@@ -1002,6 +1074,7 @@ def run_until_coverage_dist(
     transport=None,
     collect_ici: bool = False,
     stream=None,
+    control=None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
@@ -1027,7 +1100,7 @@ def run_until_coverage_dist(
         def body(st: SwarmState) -> SwarmState:
             nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                        scenario, growth, transport,
-                                       stream=stream)
+                                       stream=stream, control=control)
             return nxt
 
         return jax.lax.while_loop(cond_plain, body, state)
@@ -1039,7 +1112,7 @@ def run_until_coverage_dist(
         st, acc = carry
         nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                         scenario, growth, transport, True,
-                                        stream)
+                                        stream, control)
         return nxt, accumulate_ici(acc, ici)
 
     return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))

@@ -88,6 +88,9 @@ def matching_sampled(
     do_push: bool = True,
     do_pull: bool = False,
     interpret: bool | None = None,
+    fanout: jax.Array | None = None,
+    pull_gate: jax.Array | None = None,
+    pull_needy_rows: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Sampled (push / push-pull) delivery, gather-free.
 
@@ -97,6 +100,16 @@ def matching_sampled(
     ROW level and zeroes non-receptive rows' deliveries; returns
     ``(incoming (n_state, m) bool, msgs_sent int32 scalar)``. Edge-level
     activation is drawn once and shared across 32-slot word groups.
+
+    ``fanout`` (traced scalar, the adaptive controller's effective m —
+    control/) substitutes into the push gate law ``B(fanout/deg)``: the
+    thresholds are recomputed elementwise from the SAME degree tables
+    with the same float arithmetic, so a traced fanout equal to the
+    plan's static one yields bit-identical gates. ``pull_gate`` (traced
+    bool) masks the pull activation (billing follows — a gated round
+    bills no pull traffic). ``pull_needy_rows`` ((n_state,) bool) masks
+    the pull activation by the PULLER's need — a sated peer issues no
+    request — through the same class-expand the receptive gate rides.
     """
     if plan.fanout is None or plan.deg_other is None:
         raise ValueError("plan built without fanout — no sampling gates")
@@ -114,12 +127,19 @@ def matching_sampled(
     # precomputed uint32 thresholds would cost ~450 MB at the 10M north star
     if do_push:
         active_p = (
-            jax.random.bits(k_push, shape, jnp.uint32) < plan.push_threshold()
+            jax.random.bits(k_push, shape, jnp.uint32)
+            < plan.push_threshold(fanout)
         )
     if do_pull:
         active_q = (
             jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_threshold()
         )
+        if pull_gate is not None:
+            active_q = active_q & pull_gate
+        if pull_needy_rows is not None:
+            active_q = active_q & (
+                plan.expand(pull_needy_rows[: plan.n].astype(jnp.int32)) > 0
+            )
         pull_bill = active_q.astype(jnp.int32)
     outs = []
     for lo, w in _slot_groups(m):

@@ -579,6 +579,9 @@ def segment_sampled(
     do_push: bool = True,
     do_pull: bool = False,
     interpret: bool | None = None,
+    fanout: jax.Array | None = None,
+    pull_gate: jax.Array | None = None,
+    pull_needy_rows: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Sampled (push / push-pull) delivery as ONE staircase kernel launch.
 
@@ -638,9 +641,25 @@ def segment_sampled(
     active_p = active_q = None
     pull_bill = None
     if do_push:
-        active_p = jax.random.bits(k_push, shape, jnp.uint32) < plan.push_thresh
+        # an adaptive controller's traced effective fanout (control/)
+        # rescales the precomputed thresholds multiplicatively; the select
+        # keeps the baseline table bit-exact when the round runs at the
+        # plan's static fanout (the zero-adjustment identity). The scaled
+        # branch rounds through float32 — a <2^-24 relative probability
+        # error on an approximate Bernoulli law (the staircase engine has
+        # no bit-identity twin; the matching family recomputes exactly)
+        pt = plan.push_thresh
+        if fanout is not None:
+            scale = fanout.astype(jnp.float32) / jnp.float32(plan.fanout)
+            scaled = jnp.minimum(
+                pt.astype(jnp.float32) * scale, jnp.float32(2**32 - 2**8)
+            ).astype(jnp.uint32)
+            pt = jnp.where(fanout == plan.fanout, pt, scaled)
+        active_p = jax.random.bits(k_push, shape, jnp.uint32) < pt
     if do_pull:
         active_q = jax.random.bits(k_pull, shape, jnp.uint32) < plan.pull_thresh
+        if pull_gate is not None:
+            active_q = active_q & pull_gate
         # one request per fired pull edge, billed to the puller (the edge's
         # destination row); the pulled bits are added per group below
         pull_bill = active_q.astype(jnp.int32)
@@ -679,5 +698,13 @@ def segment_sampled(
         billed = jnp.round(bill_row).astype(jnp.int32)
         if receptive_rows is not None:
             billed = jnp.where(receptive_rows, billed, 0)
+        if pull_needy_rows is not None:
+            # needy-pull gate (control/): a sated puller issues no request
+            # — billed at row level like the receptive gate. Its edges'
+            # pull DELIVERIES still merge (a per-edge puller gather is the
+            # documented 6M-element cost this kernel avoids), which is
+            # state-exact: a sated row has every live bit the answer
+            # could carry.
+            billed = jnp.where(pull_needy_rows, billed, 0)
         msgs = msgs + jnp.sum(billed, dtype=jnp.int32)
     return incoming, msgs

@@ -88,11 +88,21 @@ class RoundStats(NamedTuple):
     stream_expired: jax.Array  # i32 — leases the age-out recycled
     slot_infected: jax.Array  # i32 (M,) — live peers holding each slot
     slot_age: jax.Array  # i32 (M,) — rounds since each slot's lease (-1 free)
+    # adaptive-control track (control/) — all 0 / -1 unless a controller
+    # is active (absent subsystems cost nothing, counters included).
+    # level/fanout report the decision that drove THIS round's delivery;
+    # msgs_duplicate is the duplicate-saturation feedback (delivered bits
+    # landing on already-seen slots — integer, bit-exact across layouts),
+    # control_refreshed counts the round's PeerSwap slot swaps.
+    control_level: jax.Array  # i32 — policy level this round (-1 off)
+    control_fanout: jax.Array  # i32 — effective fanout this round (0 off)
+    msgs_duplicate: jax.Array  # i32 — deliveries landing on already-seen slots
+    control_refreshed: jax.Array  # i32 — PeerSwap swaps applied this round
 
 
 def _stats(
     state: SwarmState, msgs_sent: jax.Array, fstats=None, growth=None,
-    stream=None, stel=None,
+    stream=None, stel=None, ctel=None,
 ) -> RoundStats:
     live = state.alive & ~state.declared_dead
     z = jnp.zeros((), dtype=jnp.int32)
@@ -139,6 +149,12 @@ def _stats(
         stream_expired=z if stel is None else stel.expired,
         slot_infected=slot_infected,
         slot_age=slot_age,
+        control_level=(
+            jnp.full((), -1, dtype=jnp.int32) if ctel is None else ctel.level
+        ),
+        control_fanout=z if ctel is None else ctel.fanout,
+        msgs_duplicate=z if ctel is None else ctel.duplicate,
+        control_refreshed=z if ctel is None else ctel.refreshed,
     )
 
 
@@ -206,6 +222,7 @@ def _disseminate_local(
     k_push: jax.Array,
     k_pull: jax.Array,
     plan=None,
+    rctl=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Single-shard dissemination; returns (incoming, msgs_sent).
 
@@ -224,9 +241,21 @@ def _disseminate_local(
     kernel's sender-side convention: a fired CSR edge into a rewired slot is
     billed though its delivery is dropped (the XLA path filters stale edges
     before counting) — an O(rewired-fraction) expected-value divergence,
-    same as the dist engine's per-puller request billing."""
+    same as the dist engine's per-puller request billing.
+
+    ``rctl`` (a :class:`~tpu_gossip.control.RoundControl`) carries an
+    active controller's round decision: the exactly-k path draws at the
+    static width ``rctl.width`` (= the policy's ``hi`` bound) and masks
+    columns past the traced effective fanout; the Bernoulli kernel paths
+    scale their activation law to ``m_eff/deg`` (same draw shapes, same
+    keys — only thresholds move); the pull half is gated by
+    ``rctl.pull_on``. With zero-adjustment bounds every mask is all-true
+    and every threshold is the static one, so the uncontrolled bits
+    reproduce exactly (tests/sim/test_control.py)."""
     msgs_sent = jnp.zeros((), dtype=jnp.int32)
     incoming = jnp.zeros_like(state.seen)
+    width = cfg.fanout if rctl is None else rctl.width
+    m_eff = None if rctl is None else rctl.m_eff
     k_push, k_rw_push = jax.random.split(k_push)
     k_pull, k_rw_pull = jax.random.split(k_pull)
     sampled_kernel = (
@@ -257,12 +286,15 @@ def _disseminate_local(
             plan, tx, answer, cfg.msg_slots, k_push,
             receptive_rows=rec_rows,
             do_push=True, do_pull=(cfg.mode == "push_pull"),
+            fanout=m_eff,
+            pull_gate=None if rctl is None else rctl.pull_on,
+            pull_needy_rows=None if rctl is None else rctl.needy,
         )
         if cfg.rewire_slots > 0:
             fresh_inc, fresh_msgs = fresh_rewire_traffic(
                 state, cfg, transmit, state.seen & transmitter,
                 receptive.any(-1), k_rw_push, k_rw_pull,
-                do_pull=(cfg.mode == "push_pull"),
+                do_pull=(cfg.mode == "push_pull"), rctl=rctl,
             )
             incoming = incoming | fresh_inc
             msgs_sent = msgs_sent + fresh_msgs
@@ -270,7 +302,7 @@ def _disseminate_local(
     if cfg.mode in ("push", "push_pull"):
         _require_csr(state, "XLA sampled delivery")
         tgt, valid = sample_fanout_targets(
-            k_push, state.row_ptr, state.col_idx, cfg.fanout
+            k_push, state.row_ptr, state.col_idx, width
         )
         if cfg.rewire_slots > 0:
             k_rw_push, k_rw_rev = jax.random.split(k_rw_push)
@@ -281,9 +313,17 @@ def _disseminate_local(
             # outbound via the substituted targets above, inbound via the
             # bidirectional reverse pass
             valid = valid & (state.rewired[:, None] | ~state.rewired[tgt])
-            rev, rev_msgs = reverse_fresh_push(state, cfg, transmit, k_rw_rev)
+            rev, rev_msgs = reverse_fresh_push(
+                state, cfg, transmit, k_rw_rev, m_eff=m_eff
+            )
             incoming = incoming | rev
             msgs_sent = msgs_sent + rev_msgs
+        if rctl is not None:
+            # exactly-k control: columns past the round's effective fanout
+            # go dark (draws keep their width-`hi` positions, so the
+            # surviving columns carry the identical bits a wider round
+            # would — and zero-adjustment bounds make the mask all-true)
+            valid = valid & (jnp.arange(width) < m_eff)[None, :]
         push_valid = valid & transmit.any(-1)[:, None]
         incoming = incoming | push_fanout(transmit, tgt, push_valid)
         msgs_sent = msgs_sent + jnp.sum(
@@ -303,6 +343,14 @@ def _disseminate_local(
             # peer's connections); a rejoiner's own fresh edges stay valid
             pvalid = pvalid & (state.rewired[:, None] | ~state.rewired[ptgt])
         pull_ok = pvalid & receptive.any(-1)[:, None]
+        if rctl is not None:
+            # push↔push-pull mix: the controller gates the anti-entropy
+            # half (requests and answers both, so billing follows
+            # delivery), and a sated peer — nothing live missing — does
+            # not issue its request at all
+            pull_ok = pull_ok & rctl.pull_on
+            if rctl.needy is not None:
+                pull_ok = pull_ok & rctl.needy[:, None]
         pull_got = pull_fanout(answer, ptgt, pull_ok)
         incoming = incoming | pull_got
         # cost = one request per puller + the responder's shipped bitmap
@@ -328,7 +376,8 @@ def _disseminate_local(
 
 
 def reverse_fresh_push(
-    state: SwarmState, cfg: SwarmConfig, transmit: jax.Array, key: jax.Array
+    state: SwarmState, cfg: SwarmConfig, transmit: jax.Array, key: jax.Array,
+    m_eff: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Delivery TO rejoiners along the reverse of their fresh edges.
 
@@ -338,12 +387,15 @@ def reverse_fresh_push(
     ``fanout/deg(t)`` — without this, a rejoined peer in push mode could
     never be re-infected (all its CSR in-edges are stale) and heavy-churn
     swarms collapse. Returns ``(incoming, msgs)``; used by both engines.
+    ``m_eff`` (traced) substitutes the controller's effective fanout into
+    the per-edge rate (identical bits when it equals ``cfg.fanout``).
     """
     s = cfg.rewire_slots
     stgt = state.rewire_targets[:, :s]
     tgt = jnp.maximum(stgt, 0)
     deg = state.row_ptr[1:] - state.row_ptr[:-1]
-    p = cfg.fanout / jnp.maximum(deg[tgt], 1)
+    f = cfg.fanout if m_eff is None else m_eff
+    p = f / jnp.maximum(deg[tgt], 1)
     fire = (
         state.rewired[:, None]
         & (stgt >= 0)
@@ -365,6 +417,7 @@ def fresh_rewire_traffic(
     k_push: jax.Array,
     k_pull: jax.Array,
     do_pull: bool,
+    rctl=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Dissemination over rejoined peers' fresh degree-preferential edges.
 
@@ -381,11 +434,13 @@ def fresh_rewire_traffic(
     """
     if cfg.rewire_compact_cap > 0:
         return _fresh_rewire_traffic_compact(
-            state, cfg, transmit, answer, receptive_any, k_push, k_pull, do_pull
+            state, cfg, transmit, answer, receptive_any, k_push, k_pull,
+            do_pull, rctl,
         )
     incoming = jnp.zeros_like(transmit)
     msgs = jnp.zeros((), dtype=jnp.int32)
     n = state.rewired.shape[0]
+    w = cfg.fanout if rctl is None else rctl.width
     k_push, k_rev = jax.random.split(k_push)
 
     def draw(key, width):
@@ -395,13 +450,18 @@ def fresh_rewire_traffic(
         )
         return jnp.maximum(stgt, 0), state.rewired[:, None] & (stgt >= 0)
 
-    tgt, valid = draw(k_push, cfg.fanout)
+    tgt, valid = draw(k_push, w)
+    if rctl is not None:
+        valid = valid & (jnp.arange(w) < rctl.m_eff)[None, :]
     push_valid = valid & transmit.any(-1)[:, None]
     incoming = incoming | push_fanout(transmit, tgt, push_valid)
     msgs = msgs + jnp.sum(
         transmit.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
     )
-    rev, rev_msgs = reverse_fresh_push(state, cfg, transmit, k_rev)
+    rev, rev_msgs = reverse_fresh_push(
+        state, cfg, transmit, k_rev,
+        m_eff=None if rctl is None else rctl.m_eff,
+    )
     incoming = incoming | rev
     msgs = msgs + rev_msgs
     if do_pull:
@@ -409,6 +469,10 @@ def fresh_rewire_traffic(
         # a dead / fully-removed rewired slot asks nobody (the local
         # engine's pull_ok gate)
         pvalid = pvalid & receptive_any[:, None]
+        if rctl is not None:
+            pvalid = pvalid & rctl.pull_on
+            if rctl.needy is not None:
+                pvalid = pvalid & rctl.needy[:, None]
         incoming = incoming | pull_fanout(answer, ptgt, pvalid)
         msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
             answer[ptgt[:, 0]].sum(-1, dtype=jnp.int32) * pvalid[:, 0]
@@ -425,6 +489,7 @@ def _fresh_rewire_traffic_compact(
     k_push: jax.Array,
     k_pull: jax.Array,
     do_pull: bool,
+    rctl=None,
 ) -> tuple[jax.Array, jax.Array]:
     """O(cap) twin of the dense fresh-edge side paths.
 
@@ -444,6 +509,7 @@ def _fresh_rewire_traffic_compact(
     cap = min(cfg.rewire_compact_cap, int(state.rewired.shape[0]))
     n = state.rewired.shape[0]
     s = cfg.rewire_slots
+    w = cfg.fanout if rctl is None else rctl.width
     incoming = jnp.zeros_like(transmit)
     k_push, k_rev = jax.random.split(k_push)
 
@@ -461,11 +527,13 @@ def _fresh_rewire_traffic_compact(
         return jnp.maximum(stgt, 0), live[:, None] & (stgt >= 0)
 
     # push: each serviced rewired row fans out to `fanout` fresh draws
-    tgt, valid = draw(k_push, cfg.fanout)
+    tgt, valid = draw(k_push, w)
+    if rctl is not None:
+        valid = valid & (jnp.arange(w) < rctl.m_eff)[None, :]
     push_valid = valid & tx_rows.any(-1)[:, None]
     payload = tx_rows[:, None, :] & push_valid[:, :, None]  # (cap, K, M)
     incoming = incoming.at[tgt.reshape(-1)].max(
-        payload.reshape(cap * cfg.fanout, -1), mode="drop"
+        payload.reshape(cap * w, -1), mode="drop"
     )
     msgs = jnp.sum(
         tx_rows.sum(-1, dtype=jnp.int32) * push_valid.sum(-1, dtype=jnp.int32)
@@ -475,7 +543,8 @@ def _fresh_rewire_traffic_compact(
     # (reverse_fresh_push's law, over the compact rows)
     rtgt = jnp.maximum(tg, 0)
     deg = state.row_ptr[1:] - state.row_ptr[:-1]
-    p = cfg.fanout / jnp.maximum(deg[rtgt], 1)
+    f = cfg.fanout if rctl is None else rctl.m_eff
+    p = f / jnp.maximum(deg[rtgt], 1)
     fire = live[:, None] & (tg >= 0) & (jax.random.uniform(k_rev, tg.shape) < p)
     back = transmit[rtgt]  # (cap, S, M)
     incoming = incoming.at[row_or_drop].max(
@@ -486,6 +555,10 @@ def _fresh_rewire_traffic_compact(
     if do_pull:
         ptgt, pvalid = draw(k_pull, 1)
         pvalid = pvalid & receptive_any[idx][:, None]
+        if rctl is not None:
+            pvalid = pvalid & rctl.pull_on
+            if rctl.needy is not None:
+                pvalid = pvalid & rctl.needy[idx][:, None]
         pulled = pull_fanout(answer, ptgt, pvalid)  # (cap, M)
         incoming = incoming.at[row_or_drop].max(pulled, mode="drop")
         msgs = msgs + jnp.sum(pvalid.astype(jnp.int32)) + jnp.sum(
@@ -718,9 +791,11 @@ def advance_round(
     fstats=None,
     growth=None,
     stream=None,
+    control=None,
+    rctl=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Everything after dissemination: dedup-merge, SIR, liveness, churn,
-    growth admission, streaming age-out + injection.
+    growth admission, streaming age-out + injection, adaptive control.
 
     Shared by the local round (:func:`gossip_round`) and the multi-chip
     round (dist/mesh.py) so the protocol state machine exists exactly once.
@@ -766,6 +841,19 @@ def advance_round(
     global shape — the protocol's split and the fault/growth draws are
     untouched, so ``stream=None`` and a zero-rate stream reproduce the
     fixed single-epidemic trajectory bit for bit.
+
+    ``control`` (a :class:`~tpu_gossip.control.ControlSpec`) runs the
+    adaptive-control stage LAST (control/engine.apply_control): the AIMD
+    level update reads this round's realized feedback (duplicate bits,
+    the fault head's loss ratio, streaming slot ages) and the PeerSwap
+    refresh re-draws fresh-edge slots from the dedicated
+    ``fold_in(state.rng, CONTROL_STREAM_SALT)`` stream at global shape —
+    the protocol's split and every other registered stream are
+    untouched, so ``control=None`` carries ``control_lvl`` untouched and
+    reproduces the uncontrolled trajectory bit for bit. ``rctl`` is the
+    round's resolved :class:`~tpu_gossip.control.RoundControl` (computed
+    by the caller BEFORE dissemination — the decision the delivered bits
+    realized).
     """
     # --- liveness (row-level) ---------------------------------------------
     # a blacked-out node is cut off from the heartbeat plane too: it emits
@@ -989,6 +1077,26 @@ def advance_round(
             declared_dead=declared_dead,
         )
 
+    # --- adaptive control (control/): AIMD level update + PeerSwap --------
+    # runs LAST so the feedback reads the round's final liveness/lease
+    # tables and the refresh acts on the post-churn/growth re-wiring
+    # plane. control=None carries the cursor untouched — the no-control
+    # hot path.
+    control_lvl = state.control_lvl
+    ctel = None
+    if control is not None:
+        from tpu_gossip.control.engine import apply_control
+
+        control_lvl, rewire_targets, degree_credit, ctel = apply_control(
+            control, state.rng, rnd, rctl,
+            incoming=incoming, seen_prev=state.seen, seen=seen,
+            alive=alive, declared_dead=declared_dead, exists=exists,
+            rewired=rewired, rewire_targets=rewire_targets,
+            degree_credit=degree_credit, row_ptr=state.row_ptr,
+            col_idx=state.col_idx, slot_lease=slot_lease,
+            rewire_slots=cfg.rewire_slots, fstats=fstats,
+        )
+
     new_state = SwarmState(
         row_ptr=state.row_ptr,
         col_idx=state.col_idx,
@@ -1008,15 +1116,17 @@ def advance_round(
         admitted_by=admitted_by,
         degree_credit=degree_credit,
         slot_lease=slot_lease,
+        control_lvl=control_lvl,
         rng=key,
         round=rnd,
     )
-    return new_state, _stats(new_state, msgs_sent, fstats, growth, stream, stel)
+    return new_state, _stats(new_state, msgs_sent, fstats, growth, stream,
+                             stel, ctel)
 
 
 def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
-    scenario=None, growth=None, stream=None,
+    scenario=None, growth=None, stream=None, control=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -1045,25 +1155,42 @@ def gossip_round(
     stream — reproduce the single-epidemic trajectory bit for bit.
     Composes with both: "flash crowd joins while a rack fails under full
     traffic" is one round call.
+
+    ``control`` (a :class:`~tpu_gossip.control.ControlSpec`) closes the
+    feedback loop (control/): the state's level cursor resolves into
+    this round's effective fanout and push↔pull mix BEFORE delivery, and
+    the AIMD update + PeerSwap refresh run as the last stage of
+    ``advance_round``. Its one stochastic stage draws from the
+    registered ``CONTROL_STREAM_SALT`` stream, so ``control=None`` — and
+    a zero-adjustment spec — reproduce the uncontrolled protocol
+    trajectory bit for bit. Composes with all three planes above.
     """
     validate_rewire_width(state, cfg)
     rnd = state.round + 1
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = compute_roles(state)
     transmit = transmit_bitmap(state, cfg, transmitter)
+    rctl = None
+    if control is not None:
+        from tpu_gossip.control.engine import control_round
+
+        rctl = control_round(control, state,
+                             want_needy=cfg.mode == "push_pull")
     if scenario is None:
         incoming, msgs_sent = _disseminate_local(
-            state, cfg, transmit, transmitter, receptive, k_push, k_pull, plan
+            state, cfg, transmit, transmitter, receptive, k_push, k_pull,
+            plan, rctl,
         )
         return advance_round(
             state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
             k_join, receptive, tail=tail, growth=growth, stream=stream,
+            control=control, rctl=rctl,
         )
     from tpu_gossip.faults.inject import scenario_dissemination
 
     def deliver(tx, tr, rc, k_dpush, k_dpull):
         return _disseminate_local(
-            state, cfg, tx, tr, rc, k_dpush, k_dpull, plan
+            state, cfg, tx, tr, rc, k_dpush, k_dpull, plan, rctl
         )
 
     incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
@@ -1074,6 +1201,7 @@ def gossip_round(
         state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
         receptive, tail=tail, faults=rf, churn_faults=scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth, stream=stream,
+        control=control, rctl=rctl,
     )
 
 
@@ -1085,6 +1213,7 @@ def gossip_round(
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
     tail: str = "fused", scenario=None, growth=None, stream=None,
+    control=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -1101,13 +1230,16 @@ def simulate(
     its cursor. ``stream`` threads a compiled streaming workload
     (traffic/) — the slot-lease table in the carry is its cursor, and
     the stacked per-round stats carry the steady-state track
-    (sim.metrics.steady_state_report consumes it).
+    (sim.metrics.steady_state_report consumes it). ``control`` threads a
+    compiled control policy (control/) — the level cursor in the carry
+    is its cursor, and the stacked stats carry the control track
+    (sim.metrics.reliability_report consumes it).
     """
 
     def body(carry, _):
         nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
                                   scenario=scenario, growth=growth,
-                                  stream=stream)
+                                  stream=stream, control=control)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -1129,6 +1261,7 @@ def run_until_coverage(
     scenario=None,
     growth=None,
     stream=None,
+    control=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -1153,7 +1286,7 @@ def run_until_coverage(
 
     def body(s: SwarmState) -> SwarmState:
         nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
-                              growth=growth, stream=stream)
+                              growth=growth, stream=stream, control=control)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

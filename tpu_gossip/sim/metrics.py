@@ -33,6 +33,7 @@ __all__ = [
     "phase_report",
     "stream_episodes",
     "steady_state_report",
+    "reliability_report",
 ]
 
 
@@ -375,6 +376,91 @@ def steady_state_report(
         },
     }
     return out
+
+
+def reliability_report(
+    stats: RoundStats,
+    *,
+    target_ratio: float,
+    coverage_target: float = 0.99,
+    round_seconds: float = 5.0,
+) -> dict:
+    """Certify the reliability contract for one run (docs/adaptive_control.md).
+
+    The adaptive controller (control/) turns "rounds-to-99%" from an
+    observed number into a CONTRACT: at a declared delivery-ratio
+    ``target_ratio``, this report says whether the run held it and what
+    it paid — **messages per delivered infection** (total protocol sends
+    over every (peer, slot) first-receipt the horizon realized) and the
+    p50/p99 **rounds-to-coverage**. Evaluated over the whole
+    ``scenarios/`` catalogue by tests/sim/test_control.py, and recorded
+    at 1M by ``bench.py control_1m``.
+
+    Streaming runs (the per-slot tracks carry data) judge per MESSAGE:
+    an episode whose lease closed inside the horizon either covered to
+    ``coverage_target`` of the then-alive swarm or expired uncovered —
+    the delivery ratio is the covered fraction (censored still-open
+    episodes judge neither way; a horizon too short to close ANY lease
+    judges nothing, reporting ``delivery_ratio`` None and a vacuous
+    ``holds`` — read ``messages_judged`` before trusting it).
+    Single-epidemic runs judge the one message: delivered iff coverage
+    ever reached ``coverage_target``. ``holds`` is the contract
+    verdict. Host-side, like every reporting helper here.
+    """
+    cov = np.asarray(stats.coverage)
+    msgs = int(np.asarray(stats.msgs_sent).astype(np.int64).sum())
+    slot_inf = np.asarray(stats.slot_infected)
+    streaming = bool(
+        np.asarray(stats.stream_offered).astype(np.int64).sum() > 0
+        or slot_inf.any()
+    )
+    if streaming:
+        # total new (peer, slot) infections: positive per-slot increments
+        # of the live-holder track (re-infections after churn/expiry are
+        # real deliveries too)
+        d = np.diff(
+            slot_inf.astype(np.int64), axis=0,
+            prepend=np.zeros((1, slot_inf.shape[1]), np.int64),
+        )
+        infections = int(np.clip(d, 0, None).sum())
+        eps = stream_episodes(stats, coverage_target)
+        done = [e["completed_age"] for e in eps if e["completed_age"] >= 0]
+        ended = [e for e in eps if e["end_round"] >= 0]
+        done_ended = sum(1 for e in ended if e["completed_age"] >= 0)
+        delivery_ratio = done_ended / len(ended) if ended else None
+        lat = np.asarray(done, dtype=np.float64)
+        p50 = float(np.percentile(lat, 50)) if lat.size else None
+        p99 = float(np.percentile(lat, 99)) if lat.size else None
+        judged = len(ended)
+    else:
+        ninf = np.asarray(stats.n_infected).astype(np.int64)
+        d = np.diff(ninf, prepend=np.int64(0))
+        infections = int(np.clip(d, 0, None).sum())
+        rtc = rounds_to_coverage(stats, coverage_target)
+        delivery_ratio = 1.0 if rtc > 0 else 0.0
+        p50 = p99 = float(rtc) if rtc > 0 else None
+        judged = 1
+    return {
+        "target_ratio": float(target_ratio),
+        "coverage_target": float(coverage_target),
+        "delivery_ratio": (
+            None if delivery_ratio is None else round(delivery_ratio, 4)
+        ),
+        "holds": bool(
+            delivery_ratio is None or delivery_ratio >= target_ratio
+        ),
+        "messages_judged": judged,
+        "msgs_total": msgs,
+        "infections_delivered": infections,
+        "msgs_per_delivered_infection": round(
+            msgs / max(infections, 1), 3
+        ),
+        "rounds_to_coverage": {"p50": p50, "p99": p99},
+        "seconds_to_coverage_p99": (
+            None if p99 is None else round(p99 * round_seconds, 1)
+        ),
+        "peak_coverage": float(cov.max()) if cov.size else 0.0,
+    }
 
 
 def expected_conflations(n_rumors: int, msg_slots: int) -> float:
