@@ -58,6 +58,8 @@ def test_matrix_structural_coverage():
         "dist[matching,simulate]", "dist[bucketed,run_until_coverage]",
         "dist[matching,sparse]", "dist[bucketed,sparse]",
         "dist[matching,control]", "dist[bucketed,control]",
+        "dist[matching,pipeline]", "dist[bucketed,pipeline]",
+        "dist[matching,pipeline+scenario+stream]",
     ):
         assert n in names, n
 

@@ -1,0 +1,437 @@
+"""Pipelined rounds (sim/stages.py, docs/pipelined_rounds.md): the
+depth-0-equals-serial contract on both mesh engines across modes × a
+chaos scenario × growth × stream × control, the depth-1 double-buffer
+semantics (flood closed form, pipelined local ↔ mesh bit-identity, scan
+continuation), and mid-pipeline checkpointing (non-empty in-flight
+buffer round-trips; pre-pipeline checkpoints load with it empty in both
+formats)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_gossip.control import compile_control
+from tpu_gossip.core import topology
+from tpu_gossip.core.state import (
+    SwarmConfig, clone_state, init_swarm, load_swarm, save_swarm,
+)
+from tpu_gossip.dist import make_mesh, shard_swarm, simulate_dist
+from tpu_gossip.faults import compile_scenario, scenario_from_dict
+from tpu_gossip.growth import compile_growth, matching_admit_rows
+from tpu_gossip.sim.engine import simulate
+from tpu_gossip.sim.stages import PipelineSpec, compile_pipeline
+from tpu_gossip.traffic import compile_stream
+
+ATTACH = 2
+_CHURN = dict(churn_leave_prob=0.02, churn_join_prob=0.2, rewire_slots=3)
+
+INT_STATS = (
+    "msgs_sent", "n_infected", "n_alive", "n_declared_dead",
+    "msgs_dropped", "msgs_held", "msgs_delivered", "n_members",
+    "stream_offered", "stream_injected", "stream_conflated",
+    "stream_expired", "slot_infected", "slot_age", "control_level",
+    "control_fanout", "msgs_duplicate", "control_refreshed",
+)
+
+
+def _assert_states_equal(a_st, b_st):
+    for f in dataclasses.fields(type(a_st)):
+        a, b = getattr(a_st, f.name), getattr(b_st, f.name)
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f.name
+        )
+
+
+def _assert_stats_equal(a, b):
+    for f in INT_STATS + ("coverage",):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+        )
+
+
+def _chaos(n_slots, n_real, node_map=None):
+    return compile_scenario(
+        scenario_from_dict({
+            "name": "pipe-chaos",
+            "phases": [
+                {"name": "lossy", "start": 0, "end": 3, "loss": 0.2,
+                 "delay": 0.2},
+                {"name": "split", "start": 3, "end": 5, "partition": "half"},
+                {"name": "storm", "start": 5, "end": 7,
+                 "churn_leave": 0.05, "churn_join": 0.2,
+                 "blackout": {"frac": 0.1, "seed": 1}},
+            ],
+        }),
+        n_peers=n_real, n_slots=n_slots, total_rounds=10,
+        node_map=node_map,
+    )
+
+
+# ------------------------------------------------------------- spec
+
+
+def test_compile_pipeline_validates():
+    assert compile_pipeline(0).depth == 0
+    assert compile_pipeline().depth == 1
+    with pytest.raises(ValueError):
+        compile_pipeline(2)
+    with pytest.raises(ValueError):
+        PipelineSpec(depth=-1)
+
+
+# --------------------------------------------- depth 0 == serial (matrix)
+
+
+@pytest.fixture(scope="module")
+def matching_setup():
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.dist import shard_matching_plan
+
+    g, plan = matching_powerlaw_graph_sharded(
+        800, 8, fanout=2, key=jax.random.key(0), growth_rows=32,
+    )
+    mesh = make_mesh(8)
+    return g, plan, shard_matching_plan(plan, mesh), mesh
+
+
+@pytest.fixture(scope="module")
+def bucketed_setup():
+    from tpu_gossip.dist import partition_graph
+    from tpu_gossip.growth import pad_graph_for_growth
+
+    rng = np.random.default_rng(0)
+    g = topology.build_csr(
+        600, topology.preferential_attachment(600, m=3, rng=rng)
+    )
+    pg, gexists = pad_graph_for_growth(g, 640)  # headroom for the grow cell
+    sg, relabeled, position = partition_graph(pg, 8, seed=0)
+    return sg, relabeled, position, gexists, make_mesh(8)
+
+
+def _matching_state(g, cfg, seed=3):
+    return init_swarm(
+        g.as_padded_graph(), cfg, origins=[0, 5], exists=g.exists,
+        key=jax.random.key(seed),
+    )
+
+
+def _matching_planes(plan, composed: bool):
+    """(scenario, growth, stream, control) for the composed matrix cell."""
+    if not composed:
+        return None, None, None, None
+    scen = _chaos(plan.n, 800)
+    gp = compile_growth(
+        n_initial=800, target=896, n_slots=plan.n, joins_per_round=12,
+        attach_m=ATTACH, admit_rows=matching_admit_rows(plan, 96),
+        max_join_burst=4,
+    )
+    sp = compile_stream(
+        rate=2.0, msg_slots=8, ttl=6,
+        origin_rows=np.flatnonzero(np.asarray(
+            jnp.ones((plan.n,), bool)))[:800],
+        k_hashes=2, burst_every=3,
+    )
+    cp = compile_control(target_ratio=0.9, fanout=2, lo=1, hi=3,
+                         refresh_every=3)
+    return scen, gp, sp, cp
+
+
+@pytest.mark.parametrize(
+    "mode,extra,composed",
+    [
+        ("flood", {}, False),
+        ("push", {}, False),
+        ("push_pull", {}, False),
+        ("push_pull", dict(rewire_slots=ATTACH, **{
+            k: v for k, v in _CHURN.items() if k != "rewire_slots"
+        }), True),
+    ],
+    ids=["flood", "push", "push_pull", "composed"],
+)
+def test_matching_depth0_bit_identical_to_serial(
+    matching_setup, mode, extra, composed
+):
+    """PipelineSpec(depth=0) reproduces the serial sharded matching run
+    BIT FOR BIT — full final state + the whole integer-stat trajectory —
+    across modes and the fully composed scenario × growth × stream ×
+    control cell (the ``control=None`` contract pattern: the off-setting
+    is the identity)."""
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode=mode,
+                      **extra)
+    scen, gp, sp, cp = _matching_planes(plan, composed)
+    st = _matching_state(g, cfg)
+    fin_s, stats_s = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, plan_m, mesh, 7, None,
+        scen, gp, stream=sp, control=cp,
+    )
+    fin_0, stats_0 = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 7, None,
+        scen, gp, stream=sp, control=cp, pipeline=compile_pipeline(0),
+    )
+    _assert_states_equal(fin_s, fin_0)
+    _assert_stats_equal(stats_s, stats_0)
+
+
+@pytest.mark.parametrize(
+    "mode,composed",
+    [("push", False), ("push_pull", False), ("push_pull", True)],
+    ids=["push", "push_pull", "composed"],
+)
+def test_bucketed_depth0_bit_identical_to_serial(
+    bucketed_setup, mode, composed
+):
+    """The same depth-0 identity on the bucketed CSR engine, including
+    the composed scenario × growth × stream × control cell (growth rides
+    the padded exists plane; the scenario carries every fault class)."""
+    from tpu_gossip.dist import init_sharded_swarm
+
+    sg, relabeled, position, gexists, mesh = bucketed_setup
+    extra = dict(rewire_slots=ATTACH, churn_leave_prob=0.01,
+                 churn_join_prob=0.05) if composed else {}
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, fanout=2, mode=mode,
+                      **extra)
+    node_map = lambda ids: position[np.asarray(ids)]  # noqa: E731
+    scen = gp = sp = cp = None
+    if composed:
+        scen = _chaos(sg.n_pad, 600, node_map=node_map)
+        gp = compile_growth(
+            n_initial=600, target=640, n_slots=sg.n_pad,
+            joins_per_round=8, attach_m=ATTACH, node_map=node_map,
+            max_join_burst=4,
+        )
+        sp = compile_stream(
+            rate=1.5, msg_slots=8, ttl=6,
+            origin_rows=position[np.arange(600)], k_hashes=1,
+        )
+        cp = compile_control(target_ratio=0.9, fanout=2, lo=1, hi=2,
+                             refresh_every=3)
+    st = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0],
+                            exists=gexists)
+    fin_s, stats_s = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, sg, mesh, 7, None,
+        scen, gp, stream=sp, control=cp,
+    )
+    fin_0, stats_0 = simulate_dist(
+        shard_swarm(st, mesh), cfg, sg, mesh, 7, None,
+        scen, gp, stream=sp, control=cp, pipeline=compile_pipeline(0),
+    )
+    _assert_states_equal(fin_s, fin_0)
+    _assert_stats_equal(stats_s, stats_0)
+
+
+# --------------------------------------------------- depth 1 semantics
+
+
+def test_flood_depth1_closed_form():
+    """The double-buffer recurrence seen_t = seen_{t-1} | F(seen_{t-2})
+    has a closed form under flood (F monotone, no draws): 2k pipelined
+    rounds land exactly on k serial rounds' seen set — the two-round
+    effective hop the overlap buys its concurrency with."""
+    rng = np.random.default_rng(0)
+    g = topology.build_csr(300, topology.preferential_attachment(300, m=2, rng=rng))
+    cfg = SwarmConfig(n_peers=300, msg_slots=4, fanout=2, mode="flood")
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(1))
+    for k in (1, 2, 3):
+        fin_p, _ = simulate(clone_state(st), cfg, 2 * k,
+                            pipeline=compile_pipeline(1))
+        fin_s, _ = simulate(clone_state(st), cfg, k)
+        np.testing.assert_array_equal(
+            np.asarray(fin_p.seen), np.asarray(fin_s.seen), err_msg=str(k)
+        )
+
+
+def test_depth1_local_vs_matching_mesh_bit_identical(matching_setup):
+    """PIPELINED runs keep the matching family's local ↔ sharded
+    bit-identity contract: the issued exchange is the engines' (already
+    bit-identical) dissemination product, and the buffer swap is
+    engine-agnostic — full state + integer stats, depth 1."""
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    st = _matching_state(g, cfg)
+    pipe = compile_pipeline(1)
+    fin_l, stats_l = simulate(clone_state(st), cfg, 6, plan, pipeline=pipe)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 6, pipeline=pipe,
+    )
+    _assert_states_equal(fin_l, fin_d)
+    _assert_stats_equal(stats_l, stats_d)
+    assert np.asarray(fin_l.pipe_buf).any()  # the buffer is genuinely live
+
+
+def test_depth1_continuation_is_exact():
+    """Splitting a pipelined run across two simulate calls lands on the
+    same trajectory: the in-flight buffer is a true state carry, so a
+    3+2 split equals a straight 5 bit for bit."""
+    rng = np.random.default_rng(2)
+    g = topology.build_csr(240, topology.preferential_attachment(240, m=3, rng=rng))
+    cfg = SwarmConfig(n_peers=240, msg_slots=4, fanout=2, mode="push_pull")
+    st = init_swarm(g, cfg, origins=[1], key=jax.random.key(4))
+    pipe = compile_pipeline(1)
+    fin_a, _ = simulate(clone_state(st), cfg, 5, pipeline=pipe)
+    mid, _ = simulate(clone_state(st), cfg, 3, pipeline=pipe)
+    assert np.asarray(mid.pipe_buf).any()
+    fin_b, _ = simulate(mid, cfg, 2, pipeline=pipe)
+    _assert_states_equal(fin_a, fin_b)
+
+
+def test_depth1_reaches_coverage():
+    """The epidemic tolerates the one-round staleness: a pipelined
+    push_pull run still converges (more rounds, same fixed point)."""
+    from tpu_gossip.sim.engine import run_until_coverage
+
+    rng = np.random.default_rng(3)
+    g = topology.build_csr(400, topology.preferential_attachment(400, m=3, rng=rng))
+    cfg = SwarmConfig(n_peers=400, msg_slots=4, fanout=2, mode="push_pull")
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(5))
+    fin = run_until_coverage(st, cfg, 0.99, 200,
+                             pipeline=compile_pipeline(1))
+    assert float(fin.coverage(0)) >= 0.99
+
+
+# ------------------------------------------------------- checkpointing
+
+
+def test_mid_pipeline_checkpoint_roundtrips_bit_exact(tmp_path):
+    """Save/resume with a NON-EMPTY in-flight buffer: the loaded state is
+    leaf-for-leaf identical, and resuming both (the saved original and
+    the loaded copy) stays bit-identical — the buffered exchange
+    delivers on the first resumed round."""
+    rng = np.random.default_rng(7)
+    g = topology.build_csr(200, topology.preferential_attachment(200, m=3, rng=rng))
+    cfg = SwarmConfig(n_peers=200, msg_slots=4, fanout=2, mode="push_pull",
+                      **_CHURN)
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(9))
+    pipe = compile_pipeline(1)
+    mid, _ = simulate(st, cfg, 3, pipeline=pipe)
+    assert np.asarray(mid.pipe_buf).any(), "fixture buffer unexpectedly empty"
+    save_swarm(tmp_path / "pipe.npz", mid)
+    loaded = load_swarm(tmp_path / "pipe.npz")
+    _assert_states_equal(mid, loaded)
+    fin_a, _ = simulate(clone_state(mid), cfg, 3, pipeline=pipe)
+    fin_b, _ = simulate(loaded, cfg, 3, pipeline=pipe)
+    _assert_states_equal(fin_a, fin_b)
+
+
+def test_pre_pipeline_named_checkpoint_loads_empty_buffer(tmp_path):
+    """A named-format checkpoint written before the field existed (the
+    key stripped) loads with an empty buffer — a pipelined run's cold
+    start, and a serial resume carries it untouched."""
+    g = topology.build_csr(64, topology.preferential_attachment(
+        64, m=2, rng=np.random.default_rng(0)))
+    cfg = SwarmConfig(n_peers=64, msg_slots=4)
+    st = init_swarm(g, cfg, origins=[1])
+    save_swarm(tmp_path / "new.npz", st)
+    data = dict(np.load(tmp_path / "new.npz"))
+    assert "field_pipe_buf" in data
+    del data["field_pipe_buf"]
+    np.savez(tmp_path / "old.npz", **data)
+    st2 = load_swarm(tmp_path / "old.npz")
+    assert st2.pipe_buf.shape == st.seen.shape
+    assert not bool(st2.pipe_buf.any())
+
+
+def test_v1_checkpoint_loads_empty_buffer(tmp_path):
+    """The legacy positional format predates the field too: it loads
+    with an empty buffer at the (N, M) slot shape."""
+    from tests.unit.test_state import save_v1
+
+    g = topology.build_csr(32, topology.preferential_attachment(
+        32, m=2, rng=np.random.default_rng(1)))
+    st = init_swarm(g, SwarmConfig(n_peers=32, msg_slots=4), origins=[2])
+    save_v1(st, tmp_path / "v1.npz", per_peer_sir=True)
+    st2 = load_swarm(tmp_path / "v1.npz")
+    assert st2.pipe_buf.shape == st.seen.shape
+    assert not bool(st2.pipe_buf.any())
+
+
+def test_serial_rounds_carry_buffer_untouched():
+    """The no-pipeline hot path never touches the buffer: a serial run
+    from a mid-pipeline state carries the in-flight plane verbatim
+    (resume-without-spec freezes it, like fault_held without its
+    scenario)."""
+    rng = np.random.default_rng(11)
+    g = topology.build_csr(150, topology.preferential_attachment(150, m=3, rng=rng))
+    cfg = SwarmConfig(n_peers=150, msg_slots=4, fanout=2, mode="push")
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(2))
+    mid, _ = simulate(st, cfg, 2, pipeline=compile_pipeline(1))
+    buf = np.asarray(mid.pipe_buf).copy()
+    assert buf.any()
+    fin, _ = simulate(mid, cfg, 3)  # serial continuation
+    np.testing.assert_array_equal(np.asarray(fin.pipe_buf), buf)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _run(argv):
+    from tpu_gossip.cli.run_sim import main
+
+    return main(argv)
+
+
+def test_cli_pipeline_requires_shard(capsys):
+    rc = _run(["--peers", "96", "--rounds", "5", "--quiet",
+               "--pipeline", "1"])
+    assert rc == 2
+    assert "--shard" in capsys.readouterr().err
+
+
+def test_cli_pipelined_shard_run_summary(capsys):
+    """A pipelined sharded run completes and reports its depth; depth 0
+    emits a summary identical to the serial run's (the CLI face of the
+    depth-0 contract — the engine-level bit-identity matrix is above)."""
+    import json
+
+    base = ["--peers", "200", "--rounds", "6", "--slots", "4",
+            "--fanout", "2", "--quiet", "--shard"]
+    assert _run(base + ["--pipeline", "1"]) == 0
+    row1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row1["pipeline"] == 1
+    assert _run(base) == 0
+    serial = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert _run(base + ["--pipeline", "0"]) == 0
+    depth0 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "pipeline" not in serial and depth0.pop("pipeline") == 0
+    assert depth0 == serial
+
+
+def test_depth1_expired_columns_die_in_the_buffer():
+    """Pipelined + streaming: a column recycled at round t must not keep
+    its retired message's bits in the in-flight buffer — the issue read
+    the pre-expiry seen plane, and without the ageout mask those bits
+    would deliver into the column's NEW lease at t+1 (cross-message
+    contamination)."""
+    rng = np.random.default_rng(13)
+    g = topology.build_csr(200, topology.preferential_attachment(200, m=3, rng=rng))
+    cfg = SwarmConfig(n_peers=200, msg_slots=4, fanout=2, mode="push_pull")
+    st = init_swarm(g, cfg, origins=[0, 1, 2], key=jax.random.key(6))
+    sp = compile_stream(rate=1.0, msg_slots=4, ttl=6,
+                        origin_rows=np.arange(200))
+    pipe = compile_pipeline(1)
+    state = clone_state(st)
+    from tpu_gossip.sim.engine import gossip_round
+    from tpu_gossip.traffic.engine import slot_expiry
+
+    saw_expiry = False
+    for _ in range(14):
+        rnd_next = int(state.round) + 1
+        expired = np.asarray(
+            slot_expiry(state.slot_lease, rnd_next, sp.ttl)
+        )
+        state, _ = gossip_round(state, cfg, stream=sp, pipeline=pipe)
+        if expired.any():
+            saw_expiry = True
+            buf = np.asarray(state.pipe_buf)
+            assert not buf[:, expired].any(), (
+                "retired message's bits survived in the in-flight buffer"
+            )
+    assert saw_expiry, "fixture never recycled a slot — raise ttl pressure"

@@ -1,0 +1,141 @@
+"""The stage-DAG driver (sim/stages.py): declared-carry enforcement at
+trace time, stage composition per config, and the driver's equivalence
+to the public ``advance_round`` (which now runs on it)."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_gossip.core.state import SwarmConfig
+from tpu_gossip.sim.stages import Stage, build_round_stages, run_stages
+
+
+def test_undeclared_read_raises():
+    st = Stage(
+        "bad", reads=("a",), writes=("out",),
+        fn=lambda ctx: {"out": ctx["b"]},  # reads b without declaring it
+    )
+    with pytest.raises(ValueError, match="reads carry 'b'"):
+        run_stages((st,), {"a": 1, "b": 2})
+
+
+def test_undeclared_write_raises():
+    st = Stage(
+        "bad", reads=("a",), writes=("out",),
+        fn=lambda ctx: {"out": ctx["a"], "sneaky": 1},
+    )
+    with pytest.raises(ValueError, match="undeclared carries \\['sneaky'\\]"):
+        run_stages((st,), {"a": 1})
+
+
+def test_missing_carry_raises():
+    st = Stage("bad", reads=("nope",), writes=(), fn=lambda ctx: {})
+    with pytest.raises(ValueError, match="declares reads \\['nope'\\]"):
+        run_stages((st,), {"a": 1})
+
+
+def test_stages_run_in_order_and_update_carries():
+    a = Stage("a", reads=("x",), writes=("y",),
+              fn=lambda ctx: {"y": ctx["x"] + 1})
+    b = Stage("b", reads=("y",), writes=("y",),
+              fn=lambda ctx: {"y": ctx["y"] * 10})
+    values = run_stages((a, b), {"x": 4})
+    assert values["y"] == 50
+
+
+def test_round_dag_composition_per_config():
+    """The stage list mirrors the config: absent subsystems contribute no
+    stage; present ones land in protocol order (liveness → churn →
+    growth → age-out → tail → inject → control)."""
+    base = SwarmConfig(n_peers=64, msg_slots=4)
+    names = [s.name for s in build_round_stages(base)]
+    assert names == ["liveness", "tail"]
+
+    churn = SwarmConfig(n_peers=64, msg_slots=4, churn_leave_prob=0.01,
+                        churn_join_prob=0.1)
+    names = [s.name for s in build_round_stages(churn)]
+    assert names == ["liveness", "churn", "tail"]
+
+    # a burst scenario forces the churn stage even at zero configured churn
+    names = [s.name for s in build_round_stages(
+        base, has_faults=True, churn_faults=True
+    )]
+    assert names == ["liveness", "churn", "tail"]
+
+    class _FakeStream:
+        ttl = 4
+
+    class _FakeControl:
+        pass
+
+    class _FakeGrowth:
+        attach_m = 0
+
+    names = [s.name for s in build_round_stages(
+        churn, growth=_FakeGrowth(), stream=_FakeStream(),
+        control=_FakeControl(),
+    )]
+    assert names == [
+        "liveness", "churn", "growth", "stream_ageout", "tail",
+        "stream_inject", "control",
+    ]
+
+
+def test_growth_stage_validates_attach_width():
+    class _FakeGrowth:
+        attach_m = 3
+
+    cfg = SwarmConfig(n_peers=64, msg_slots=4, rewire_slots=1)
+    with pytest.raises(ValueError, match="attach_m"):
+        build_round_stages(cfg, growth=_FakeGrowth())
+
+
+def test_stage_view_is_a_mapping():
+    st = Stage("m", reads=("a", "b"), writes=(), fn=lambda ctx: {})
+    from tpu_gossip.sim.stages import StageView
+
+    view = StageView({"a": 1, "b": 2, "c": 3}, st)
+    assert dict(view) == {"a": 1, "b": 2}
+    assert len(view) == 2
+
+
+def test_declarations_cover_real_round():
+    """Every stage of a fully-composed config declares carries that the
+    initial set + earlier stages satisfy (the driver would raise inside
+    jit otherwise — this pins it cheaply, without a trace)."""
+    cfg = SwarmConfig(n_peers=64, msg_slots=4, churn_leave_prob=0.01,
+                      churn_join_prob=0.1, rewire_slots=2)
+    initial = {
+        "row_ptr", "col_idx", "seen", "forwarded", "infected_round",
+        "recovered", "exists", "alive", "silent", "last_hb",
+        "declared_dead", "rewired", "rewire_targets", "join_round",
+        "admitted_by", "degree_credit", "slot_lease", "control_lvl",
+        "rng", "incoming", "transmit", "receptive", "rnd", "k_leave",
+        "k_join", "faults", "fstats", "rctl", "seen_prev", "held",
+        "fresh", "expired", "stel", "ctel",
+    }
+
+    class _FakeStream:
+        ttl = 4
+
+    class _FakeControl:
+        pass
+
+    class _FakeGrowth:
+        attach_m = 2
+
+    have = set(initial)
+    for st in build_round_stages(
+        cfg, has_faults=True, churn_faults=True, growth=_FakeGrowth(),
+        stream=_FakeStream(), control=_FakeControl(),
+    ):
+        missing = set(st.reads) - have
+        assert not missing, (st.name, missing)
+        have |= set(st.writes)
+
+
+def test_jnp_available_in_stage_bodies():
+    """Smoke: stage fns run under tracing (they're plain callables)."""
+    st = Stage("t", reads=("x",), writes=("y",),
+               fn=lambda ctx: {"y": jnp.asarray(ctx["x"]) + 1})
+    out = run_stages((st,), {"x": 1})
+    assert int(out["y"]) == 2

@@ -520,6 +520,10 @@ def _dist_entries() -> list[EntryPoint]:
                 kw["stream"] = _stream_plan(16, st.exists)
             if kw.pop("control", False):
                 kw["control"] = _control_plan()
+            if kw.pop("pipeline", False):
+                from tpu_gossip.sim.stages import compile_pipeline
+
+                kw["pipeline"] = compile_pipeline(1)
             if kind == "round":
                 fn = lambda s: mesh_mod.gossip_round_dist(  # noqa: E731
                     s, cfg, graph_plan, mesh, **kw
@@ -592,6 +596,28 @@ def _dist_entries() -> list[EntryPoint]:
         "dist[bucketed,control]", "dist-bucketed", "gossip_round_dist",
         dict(rewire_slots=2, churn_join_prob=0.02, churn_leave_prob=0.002),
         dict(control=True),
+    ))
+    # the PIPELINED mesh round (sim/stages.py): the double-buffered
+    # exchange must keep both engine families a state fixed point — the
+    # in-flight buffer (pipe_buf) rides scan/while carries and
+    # checkpoints like any other cursor, and the issue-side draws keep
+    # the lineage contract (same keys as serial, test-pinned depth-0
+    # identity)
+    eps.append(dist_ep(
+        "dist[matching,pipeline]", "dist-matching", "gossip_round_dist",
+        {}, dict(pipeline=True),
+    ))
+    eps.append(dist_ep(
+        "dist[bucketed,pipeline]", "dist-bucketed", "gossip_round_dist",
+        {}, dict(pipeline=True),
+    ))
+    # pipelined × scenario × stream composed: the overlap schedule under
+    # an active fault head and a loaded lease table — the maximal
+    # pipelined carry surface (held buffer + lease cursor + pipe_buf)
+    eps.append(dist_ep(
+        "dist[matching,pipeline+scenario+stream]", "dist-matching",
+        "gossip_round_dist", {}, dict(pipeline=True, scenario=True,
+                                      stream=True),
     ))
     # the jitted dist loop entries (donating) — scan/while over shard_map
     eps.append(dist_ep(

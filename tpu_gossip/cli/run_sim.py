@@ -114,6 +114,17 @@ def build_parser() -> argparse.ArgumentParser:
         "local engine only",
     )
     p.add_argument(
+        "--pipeline", type=int, choices=[0, 1], default=None, metavar="DEPTH",
+        help="pipelined sharded rounds (sim/stages.py, docs/"
+        "pipelined_rounds.md): 1 double-buffers the exchange — the "
+        "collective for this round's transmit plane is issued while the "
+        "previous round's buffered exchange runs the shard-local tail "
+        "(delivery one round stale; round throughput, not per-hop "
+        "latency, is the win); 0 is the serial schedule, bit-identical "
+        "to omitting the flag (the determinism contract's anchor). "
+        "Requires --shard — the overlap targets the mesh collectives",
+    )
+    p.add_argument(
         "--profile-round", type=int, default=0, metavar="R",
         help="instead of the normal run: advance R warm rounds, then "
         "slope-time the round's stage decomposition (delivery, tail per "
@@ -307,6 +318,11 @@ def main(argv: list[str] | None = None) -> int:
               "experiments/dist_profile.py for the mesh engines)",
               file=sys.stderr)
         return 2
+    if args.pipeline is not None and not args.shard:
+        print("--pipeline overlaps the SHARDED exchange with the "
+              "shard-local tail (sim/stages.py); add --shard (the local "
+              "engine has no collective to overlap)", file=sys.stderr)
+        return 2
     if args.transport != "dense" and not args.shard:
         # parse-time rejection, like --scenario path errors: the transport
         # compacts the SHARDED exchanges — a local run has no collective
@@ -413,7 +429,17 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_gossip.utils.profiling import trace
 
     if args.profile_round > 0:
-        return _main_profile_round(args, cfg, state, plan)
+        # the decomposition composes with the post-PR-3 planes: a growing
+        # / loaded / controlled profile measures those stages too
+        grow_p = _compile_cli_growth(args, spec, n_slots=graph.n, mplan=mplan)
+        strm_p = _compile_cli_stream(
+            args,
+            np.flatnonzero(np.asarray(exists)) if exists is not None
+            else np.arange(graph.n),
+        )
+        ctl_p = _compile_cli_control(args)
+        return _main_profile_round(args, cfg, state, plan, grow_p, strm_p,
+                                   ctl_p)
 
     scen = _compile_cli_scenario(spec, args, n_slots=graph.n)
     grow = _compile_cli_growth(args, spec, n_slots=graph.n, mplan=mplan)
@@ -495,8 +521,6 @@ def _validate_grow(args, spec):
     if args.m >= args.peers:
         return (f"--m {args.m} fresh edges per joiner needs at least that "
                 f"many initial peers (--peers {args.peers})")
-    if args.profile_round > 0:
-        return "--profile-round measures the fixed-n round; drop --grow"
     if args.shard and args.remat_every > 0:
         return ("--grow cannot compose with --shard --remat-every: the "
                 "epoch re-partition permutes peers, so the compiled "
@@ -527,10 +551,9 @@ def _validate_stream(args):
 
     if args.stream < 0:
         return f"--stream {args.stream} must be a non-negative arrival rate"
-    if args.profile_round > 0:
-        return ("--profile-round measures the unloaded round's stage "
-                "decomposition; drop --stream")
-    if args.rounds <= 0:
+    if args.rounds <= 0 and args.profile_round == 0:
+        # (--profile-round slope-times stages instead of running a
+        # horizon, so the steady-state requirement does not bind it)
         return ("--stream measures a steady state over a fixed horizon — "
                 "run-to-coverage stops on slot 0, which the age-out "
                 "recycles; pass --rounds R (R >> --slot-ttl)")
@@ -589,9 +612,6 @@ def _validate_control(args):
         return ("--control modulates the sampled fanout and the "
                 "anti-entropy mix; flood delivery has neither — use "
                 "--mode push or push_pull")
-    if args.profile_round > 0:
-        return ("--profile-round measures the static round's stage "
-                "decomposition; drop --control")
     rewire = _rewire_slots(args)
     if args.control_bounds:
         try:
@@ -788,6 +808,21 @@ def _compile_cli_scenario(
     )
 
 
+def _pipeline_summary(args) -> dict:
+    """Summary-row pipeline field for a --shard run (absent = serial)."""
+    if args.pipeline is None:
+        return {}
+    return {"pipeline": args.pipeline}
+
+
+def _compile_cli_pipeline(args):
+    if args.pipeline is None:
+        return None
+    from tpu_gossip.sim.stages import compile_pipeline
+
+    return compile_pipeline(args.pipeline)
+
+
 def _transport_summary(args, ici=None, rounds=0) -> dict:
     """Summary-row transport fields for a --shard run: the configured lane
     plus, when the analytic counter ran, realized occupancy/bytes —
@@ -830,26 +865,47 @@ def _scenario_summary(spec, stats=None) -> dict:
     return out
 
 
-def _main_profile_round(args, cfg, state, plan) -> int:
+def _main_profile_round(args, cfg, state, plan, grow=None, strm=None,
+                        ctl=None) -> int:
     """--profile-round R: the slope-timed stage decomposition of one round.
 
     Advances R rounds first (mid-epidemic slot densities — a cold state
-    makes every stage trivially sparse), then times each stage and the
-    composed round per tail implementation. The summary JSON carries
-    ms-per-round figures; the human-readable table goes to stderr.
+    makes every stage trivially sparse; with growth/stream/control the
+    warm rounds run those planes so the registry/lease/cursor state is
+    mid-flight too), then times each stage and the composed round per
+    tail implementation. The post-PR-3 stages ride along: ``growth`` /
+    ``stream`` / ``control`` rows appear when the matching flags are
+    set, and ``transport_compact`` always measures the sparse lane's
+    shard-local compaction round-trip at this swarm's synthetic 8-shard
+    bucket geometry (the dims the dist engine would use). The summary
+    JSON carries ms-per-round figures; the human-readable table goes to
+    stderr.
     """
     from tpu_gossip.core.state import clone_state
+    from tpu_gossip.kernels.pallas_segment import _slot_groups
     from tpu_gossip.sim.engine import simulate
     from tpu_gossip.utils.profiling import (
         format_stage_table, profile_round_stages, trace,
     )
 
-    warm, _ = simulate(clone_state(state), cfg, args.profile_round, plan)
+    warm, _ = simulate(clone_state(state), cfg, args.profile_round, plan,
+                       growth=grow, stream=strm, control=ctl)
     tails = ("reference", "fused") if args.tail != "pallas" else (
         "reference", "fused", "pallas",
     )
+    # synthetic dist bucket geometry for the compaction probe: 8 shards,
+    # capacity = per-(src,dst)-pair directed edges rounded to whole
+    # 1024-entry windows (partition_graph's law), budget = 1/8 of it
+    # (build_transport's default compact_frac)
+    s_probe = 8
+    e_real = int(np.asarray(state.row_ptr)[-1])
+    b_probe = max(1024, -(-e_real // (s_probe * s_probe * 1024)) * 1024)
+    probe = (s_probe, b_probe, len(_slot_groups(args.slots)),
+             max(b_probe // 8, 1))
     with trace(args.profile):  # --profile DIR composes: xprof the stages
-        stages = profile_round_stages(warm, cfg, plan, tails=tails)
+        stages = profile_round_stages(warm, cfg, plan, tails=tails,
+                                      growth=grow, stream=strm, control=ctl,
+                                      transport_probe=probe)
     print(format_stage_table(stages), file=sys.stderr)
     import math
 
@@ -1007,7 +1063,7 @@ def _horizon_summary(args, stats, **extra):
 
 
 def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
-                          ctl=None):
+                          ctl=None, pipe=None):
     """The mesh epoch loop (SURVEY.md §7.4's full churn lifecycle):
 
         R churned rounds -> fold fresh edges into the CSR
@@ -1055,12 +1111,13 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
     seg0 = min(r, total)
     if args.rounds > 0:
         warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans,
-                             scen, None, transport, control=ctl)[0]
+                             scen, None, transport, control=ctl,
+                             pipeline=pipe)[0]
     else:
         warm = run_until_coverage_dist(
             clone_state(state), cfg, sg, mesh, args.target, seg0,
             shard_plan=plans, scenario=scen, transport=transport,
-            control=ctl,
+            control=ctl, pipeline=pipe,
         )
     float(warm.coverage(0))
     del warm
@@ -1070,12 +1127,14 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
         seg = min(r, total - int(state.round))
         if args.rounds > 0:
             state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans,
-                                         scen, None, transport, control=ctl)
+                                         scen, None, transport, control=ctl,
+                                         pipeline=pipe)
             stats_parts.append(stats)
         else:
             state = run_until_coverage_dist(
                 state, cfg, sg, mesh, args.target, seg, shard_plan=plans,
                 scenario=scen, transport=transport, control=ctl,
+                pipeline=pipe,
             )
             if float(state.coverage(0)) >= args.target:
                 break
@@ -1236,17 +1295,19 @@ def _main_shard_matching(args, rng, spec=None) -> int:
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
     strm = _compile_cli_stream(args, to_rows(np.arange(args.peers)))
     ctl = _compile_cli_control(args)
+    pipe = _compile_cli_pipeline(args)
     with trace(args.profile):
         if args.rounds > 0:
             if transport is not None:
                 fin, (stats, ici) = simulate_dist(
                     state, cfg, plan, mesh, args.rounds, None, scen, grow,
-                    transport, True, strm, ctl,
+                    transport, True, strm, ctl, pipe,
                 )
             else:
                 fin, stats = simulate_dist(state, cfg, plan, mesh,
                                            args.rounds, None, scen, grow,
-                                           stream=strm, control=ctl)
+                                           stream=strm, control=ctl,
+                                           pipeline=pipe)
                 ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -1254,6 +1315,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                 args, stats, devices=mesh.size,
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
+                **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
             )
@@ -1267,7 +1329,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
                 return run_until_coverage_dist(
                     st, cfg, plan, mesh, args.target, args.max_rounds,
                     scenario=scen, growth=grow, transport=transport,
-                    control=ctl,
+                    control=ctl, pipeline=pipe,
                 )
 
             r0 = int(state.round)
@@ -1282,12 +1344,13 @@ def _main_shard_matching(args, rng, spec=None) -> int:
 
                 _, (_stats, ici) = simulate_dist(
                     clone_state(state), cfg, plan, mesh, rounds, None, scen,
-                    grow, transport, True, control=ctl,
+                    grow, transport, True, control=ctl, pipeline=pipe,
                 )
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
                        **_scenario_summary(spec),
                        **_transport_summary(args, ici, rounds),
+                       **_pipeline_summary(args),
                        **_control_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
@@ -1363,24 +1426,26 @@ def _main_shard(args, graph, rng, spec=None) -> int:
     )
     strm = _compile_cli_stream(args, position[np.arange(args.peers)])
     ctl = _compile_cli_control(args)
+    pipe = _compile_cli_pipeline(args)
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
-                args, cfg, state, sg, mesh, plans, scen, ctl
+                args, cfg, state, sg, mesh, plans, scen, ctl, pipe
             )
             summary.update(_scenario_summary(spec))
             summary.update(_transport_summary(args))
+            summary.update(_pipeline_summary(args))
             summary.update(_control_summary(args))
         elif args.rounds > 0:
             if transport is not None:
                 fin, (stats, ici) = simulate_dist(
                     state, cfg, sg, mesh, args.rounds, plans, scen, grow,
-                    transport, True, strm, ctl,
+                    transport, True, strm, ctl, pipe,
                 )
             else:
                 fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
                                            plans, scen, grow, stream=strm,
-                                           control=ctl)
+                                           control=ctl, pipeline=pipe)
                 ici = None
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -1388,6 +1453,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 args, stats, devices=mesh.size,
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
+                **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
             )
@@ -1402,7 +1468,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 return run_until_coverage_dist(
                     st, cfg, sg, mesh, args.target, args.max_rounds,
                     shard_plan=plans, scenario=scen, growth=grow,
-                    transport=transport, control=ctl,
+                    transport=transport, control=ctl, pipeline=pipe,
                 )
 
             r0 = int(state.round)
@@ -1417,11 +1483,12 @@ def _main_shard(args, graph, rng, spec=None) -> int:
 
                 _, (_stats, ici) = simulate_dist(
                     clone_state(state), cfg, sg, mesh, rounds, plans, scen,
-                    grow, transport, True, control=ctl,
+                    grow, transport, True, control=ctl, pipeline=pipe,
                 )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
                        **_scenario_summary(spec),
                        **_transport_summary(args, ici, rounds),
+                       **_pipeline_summary(args),
                        **_control_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))

@@ -174,6 +174,19 @@ class SwarmState:
     # (an uncontrolled run never pays for it); checkpoints that predate
     # the field load with it -1.
     control_lvl: jax.Array  # int32 () scalar
+    # pipelined-round in-flight buffer (sim/stages.py, docs/
+    # pipelined_rounds.md): the exchange issued last round and not yet
+    # delivered. Under ``PipelineSpec(depth=1)`` each round consumes this
+    # plane through the protocol tail while it issues the CURRENT
+    # transmit plane's collective into it — the double buffer that lets
+    # the ICI exchange overlap the shard-local tail. Like ``fault_held``
+    # this is a checkpointable CARRY: a mid-pipeline checkpoint resumes
+    # bit-exactly (the buffered round delivers on the first resumed
+    # round). The serial round path (pipeline=None / depth 0) carries it
+    # UNTOUCHED (all-False — an unpipelined run never pays for it);
+    # checkpoints that predate the field load with it empty, which is
+    # also a pipelined run's cold-start state (round 1 delivers nothing).
+    pipe_buf: jax.Array  # bool (N, M)
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -234,7 +247,8 @@ def load_swarm(path) -> SwarmState:
             if f"prngkey_{f.name}" in data:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
             elif (
-                f.name in ("fault_held", "slot_lease", "control_lvl")
+                f.name in ("fault_held", "slot_lease", "control_lvl",
+                           "pipe_buf")
                 or f.name in _GROWTH_FIELDS
             ) and f"field_{f.name}" not in data:
                 continue  # pre-scenario/growth/stream/control checkpoint:
@@ -251,6 +265,10 @@ def load_swarm(path) -> SwarmState:
             # pre-control checkpoint: uninitialized cursor (a controller
             # attached on resume starts at its widest level)
             kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
+        if "pipe_buf" not in kwargs:
+            # pre-pipeline checkpoint: empty in-flight buffer — exactly a
+            # pipelined run's cold start (round 1 delivers nothing)
+            kwargs["pipe_buf"] = jnp.zeros(kwargs["seen"].shape, dtype=bool)
     else:  # legacy positional layout
         for i, name in enumerate(_V1_FIELDS):
             if f"key_{i}" in data:
@@ -276,6 +294,7 @@ def load_swarm(path) -> SwarmState:
         kwargs.update(_zero_registry(kwargs["exists"]))
         kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
         kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
+        kwargs["pipe_buf"] = jnp.zeros((n, m), dtype=bool)
     return SwarmState(**kwargs)
 
 
@@ -459,6 +478,7 @@ def init_swarm(
         degree_credit=jnp.zeros((n,), dtype=jnp.int32),
         slot_lease=slot_lease,
         control_lvl=jnp.asarray(-1, dtype=jnp.int32),
+        pipe_buf=jnp.zeros((n, m), dtype=bool),
         rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

@@ -511,6 +511,7 @@ def gossip_round_dist_matching(
     collect_ici: bool = False,
     stream=None,
     control=None,
+    pipeline=None,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -529,12 +530,16 @@ def gossip_round_dist_matching(
     ``stream`` (traffic/) injects the streaming workload the same way —
     a LOADED mesh round stays bit-identical to its local twin, the
     serving extension of the contract (tests/sim/test_traffic.py).
+    ``pipeline`` (sim/stages.py, static) selects the double-buffered
+    schedule: at depth 1 the transpose pipeline for THIS round's
+    transmit plane is issued into ``state.pipe_buf`` while the previous
+    round's buffered exchange delivers through the shard-local tail —
+    and because the local engine buffers its dissemination product
+    identically, PIPELINED runs stay bit-identical local vs mesh
+    (tests/sim/test_pipeline.py); depth 0 is serial bit for bit.
     """
-    from tpu_gossip.sim.engine import (
-        advance_round,
-        compute_roles,
-        transmit_bitmap,
-        validate_rewire_width,
+    from tpu_gossip.sim.stages import (
+        effective_transmit_planes, run_protocol_round,
     )
 
     if plan.mesh_shards != mesh.size:
@@ -554,52 +559,23 @@ def gossip_round_dist_matching(
                 f"plan built for fanout={plan.fanout} but cfg.fanout="
                 f"{cfg.fanout}"
             )
-    validate_rewire_width(state, cfg)
-    rnd = state.round + 1
-    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
-    _, transmitter, receptive = compute_roles(state)
-    transmit = transmit_bitmap(state, cfg, transmitter)
-    rctl = None
-    if control is not None:
-        from tpu_gossip.control.engine import control_round
 
-        rctl = control_round(control, state,
-                             want_needy=cfg.mode == "push_pull")
-
-    if scenario is None:
-        incoming, msgs_sent = _disseminate_matching_dist(
-            state, cfg, plan, mesh, transmit, transmitter, receptive,
-            k_push, k_pull, transport, rctl,
-        )
-        out = advance_round(
-            state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, growth=growth, stream=stream,
-            control=control, rctl=rctl,
-        )
-        if not collect_ici:
-            return out
-        return (*out, _ici_matching(state, cfg, plan, transport, transmit,
-                                    transmitter, receptive))
-    from tpu_gossip.faults.inject import scenario_dissemination
-
-    def deliver(tx, tr, rc, k_dpush, k_dpull):
+    def disseminate(tx, tr, rc, k_dpush, k_dpull, rctl):
         return _disseminate_matching_dist(
             state, cfg, plan, mesh, tx, tr, rc, k_dpush, k_dpull, transport,
             rctl,
         )
 
-    incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
-        scenario, state, rnd, transmit, transmitter, receptive,
-        k_push, k_pull, deliver,
-    )
-    out = advance_round(
-        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
-        receptive, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem, growth=growth, stream=stream,
-        control=control, rctl=rctl,
+    out = run_protocol_round(
+        state, cfg, disseminate, scenario=scenario, growth=growth,
+        stream=stream, control=control, pipeline=pipeline,
     )
     if not collect_ici:
         return out
+    # the counter charges the round's ISSUED exchange (pipelined included)
+    tx_eff, transmitter, receptive = effective_transmit_planes(
+        state, cfg, scenario
+    )
     return (*out, _ici_matching(state, cfg, plan, transport, tx_eff,
                                 transmitter, receptive))
 

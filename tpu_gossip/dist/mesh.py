@@ -49,11 +49,7 @@ from tpu_gossip.dist._compat import shard_map_compat
 from tpu_gossip.dist.matching_mesh import gossip_round_dist_matching
 from tpu_gossip.sim.engine import (
     RoundStats,
-    advance_round,
-    compute_roles,
     fresh_rewire_traffic,
-    transmit_bitmap,
-    validate_rewire_width,
 )
 
 __all__ = [
@@ -884,6 +880,7 @@ def gossip_round_dist(
     collect_ici: bool = False,
     stream=None,
     control=None,
+    pipeline=None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -915,8 +912,19 @@ def gossip_round_dist(
     global-shape-draw guarantee — loaded swarms keep each engine
     family's parity contract. ``control`` (control/) closes the
     adaptive-fanout feedback loop through the shared stage with the same
-    guarantee — controlled swarms keep it too."""
+    guarantee — controlled swarms keep it too. ``pipeline`` (a
+    :class:`~tpu_gossip.sim.stages.PipelineSpec`, static) selects the
+    double-buffered exchange schedule (docs/pipelined_rounds.md): at
+    depth 1 the bucketed ``all_to_all`` for THIS round's transmit plane
+    is issued into ``state.pipe_buf`` while the previous round's
+    buffered exchange delivers through the shard-local tail — the
+    collective and the tail share no data dependency, so they overlap;
+    depth 0 (and ``pipeline=None``) is the serial schedule bit for
+    bit."""
     from tpu_gossip.core.matching_topology import MatchingPlan
+    from tpu_gossip.sim.stages import (
+        effective_transmit_planes, run_protocol_round,
+    )
 
     if isinstance(sg, MatchingPlan):
         if shard_plan is not None:
@@ -929,59 +937,31 @@ def gossip_round_dist(
                                           scenario=scenario, growth=growth,
                                           transport=transport,
                                           collect_ici=collect_ici,
-                                          stream=stream, control=control)
+                                          stream=stream, control=control,
+                                          pipeline=pipeline)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
             f"{mesh.size} devices — repartition with partition_graph(g, {mesh.size})"
         )
-    validate_rewire_width(state, cfg)
-    rnd = state.round + 1
-    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
-    _, transmitter, receptive = compute_roles(state)
-    transmit = transmit_bitmap(state, cfg, transmitter)
-    rctl = None
-    if control is not None:
-        from tpu_gossip.control.engine import control_round
 
-        rctl = control_round(control, state,
-                             want_needy=cfg.mode == "push_pull")
-    if scenario is None:
-        incoming, msgs_sent = _disseminate_bucketed(
-            state, cfg, sg, mesh, shard_plan, transmit, transmitter,
-            receptive, k_push, k_pull, transport, rctl,
-        )
-        out = advance_round(
-            state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, growth=growth, stream=stream,
-            control=control, rctl=rctl,
-        )
-        if not collect_ici:
-            return out
-        return (*out, _ici_bucketed(state, cfg, sg, transport, transmit,
-                                    transmitter))
-    from tpu_gossip.faults.inject import scenario_dissemination
-
-    def deliver(tx, tr, rc, k_dpush, k_dpull):
+    def disseminate(tx, tr, rc, k_dpush, k_dpull, rctl):
         return _disseminate_bucketed(
             state, cfg, sg, mesh, shard_plan, tx, tr, rc, k_dpush, k_dpull,
             transport, rctl,
         )
 
-    incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
-        scenario, state, rnd, transmit, transmitter, receptive,
-        k_push, k_pull, deliver,
-    )
-    out = advance_round(
-        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
-        receptive, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem, growth=growth, stream=stream,
-        control=control, rctl=rctl,
+    out = run_protocol_round(
+        state, cfg, disseminate, scenario=scenario, growth=growth,
+        stream=stream, control=control, pipeline=pipeline,
     )
     if not collect_ici:
         return out
     # fault-free single-pass model on the effective (post-blackout)
-    # transmit plane — see IciRound's docstring for the approximation
+    # transmit plane — see IciRound's docstring for the approximation.
+    # The counter charges the round's ISSUED exchange (under a pipelined
+    # schedule too: the issue is what moves bytes this round).
+    tx_eff, transmitter, _ = effective_transmit_planes(state, cfg, scenario)
     return (*out, _ici_bucketed(state, cfg, sg, transport, tx_eff,
                                 transmitter))
 
@@ -1010,7 +990,7 @@ def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_rounds", "collect_ici"),
+    static_argnames=("cfg", "mesh", "num_rounds", "collect_ici", "pipeline"),
     donate_argnames=("state",),
 )
 def simulate_dist(
@@ -1026,6 +1006,7 @@ def simulate_dist(
     collect_ici: bool = False,
     stream=None,
     control=None,
+    pipeline=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -1045,7 +1026,7 @@ def simulate_dist(
     def body(carry, _):
         out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
                                 scenario, growth, transport, collect_ici,
-                                stream, control)
+                                stream, control, pipeline)
         if collect_ici:
             nxt, stats, ici = out
             return nxt, (stats, ici)
@@ -1057,7 +1038,8 @@ def simulate_dist(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "max_rounds", "slot", "collect_ici"),
+    static_argnames=("cfg", "mesh", "max_rounds", "slot", "collect_ici",
+                     "pipeline"),
     donate_argnames=("state",),
 )
 def run_until_coverage_dist(
@@ -1075,6 +1057,7 @@ def run_until_coverage_dist(
     collect_ici: bool = False,
     stream=None,
     control=None,
+    pipeline=None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
@@ -1100,7 +1083,8 @@ def run_until_coverage_dist(
         def body(st: SwarmState) -> SwarmState:
             nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                        scenario, growth, transport,
-                                       stream=stream, control=control)
+                                       stream=stream, control=control,
+                                       pipeline=pipeline)
             return nxt
 
         return jax.lax.while_loop(cond_plain, body, state)
@@ -1112,7 +1096,7 @@ def run_until_coverage_dist(
         st, acc = carry
         nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                         scenario, growth, transport, True,
-                                        stream, control)
+                                        stream, control, pipeline)
         return nxt, accumulate_ici(acc, ici)
 
     return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))

@@ -13,5 +13,13 @@ from tpu_gossip.sim.engine import (
     simulate,
     run_until_coverage,
 )
+from tpu_gossip.sim.stages import PipelineSpec, compile_pipeline
 
-__all__ = ["RoundStats", "gossip_round", "simulate", "run_until_coverage"]
+__all__ = [
+    "RoundStats",
+    "gossip_round",
+    "simulate",
+    "run_until_coverage",
+    "PipelineSpec",
+    "compile_pipeline",
+]

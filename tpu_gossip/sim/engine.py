@@ -34,8 +34,6 @@ from tpu_gossip.kernels.gossip import (
     push_fanout,
     sample_fanout_targets,
 )
-from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
-from tpu_gossip.kernels.round_tail import round_tail
 
 __all__ = [
     "RoundStats",
@@ -793,12 +791,18 @@ def advance_round(
     stream=None,
     control=None,
     rctl=None,
+    pipe_buf: jax.Array | None = None,
 ) -> tuple[SwarmState, RoundStats]:
     """Everything after dissemination: dedup-merge, SIR, liveness, churn,
     growth admission, streaming age-out + injection, adaptive control.
 
     Shared by the local round (:func:`gossip_round`) and the multi-chip
     round (dist/mesh.py) so the protocol state machine exists exactly once.
+    Since the stage-DAG refactor the body is a declared-carry stage list
+    (``sim.stages.build_round_stages`` run by ``sim.stages.run_stages``):
+    each stage names the state slices it reads and writes, and the driver
+    enforces the declarations at trace time — the jaxpr is op-for-op the
+    historical hand-ordered sequence (the parity matrix pins it).
 
     Structured as row-level work first (liveness counters, churn draws —
     O(N)), then ONE fused traversal of the (N, M) slot arrays
@@ -854,279 +858,85 @@ def advance_round(
     round's resolved :class:`~tpu_gossip.control.RoundControl` (computed
     by the caller BEFORE dissemination — the decision the delivered bits
     realized).
+    ``pipe_buf`` (pipelined rounds, sim/stages.py): the in-flight
+    exchange buffer to STORE in the new state — the collective the
+    caller just issued for the next round's delivery. ``None`` (every
+    serial caller) carries ``state.pipe_buf`` untouched, the no-pipeline
+    hot path.
     """
-    # --- liveness (row-level) ---------------------------------------------
-    # a blacked-out node is cut off from the heartbeat plane too: it emits
-    # nothing anyone hears and answers no detector probe, exactly a silent
-    # peer for the phase's duration — dead declarations it earns persist
-    # (the reference's registry purge has no resurrection either)
-    silent_now = (
-        state.silent if faults is None else state.silent | faults.blackout
-    )
-    last_hb = emit_heartbeats(
-        state.last_hb, state.alive, silent_now, state.declared_dead,
-        rnd, cfg.hb_period_rounds,
-    )
-    last_hb, declared_dead = detect_failures(
-        last_hb, state.alive, silent_now, state.declared_dead,
-        rnd, cfg.timeout_rounds, cfg.detect_period_rounds,
-    )
+    from tpu_gossip.sim.stages import build_round_stages, run_stages
 
-    # --- Poisson churn (BASELINE config 5), row-level half ----------------
-    # the fresh-slot SLOT-ARRAY resets are deferred to the fused tail below
-    # (they commute with the dedup merge: the join draws read only
-    # row-level state, and the tail folds `& ~fresh` into the producing
-    # expressions instead of a second sweep over the slot arrays)
-    alive = state.alive
-    silent = state.silent
-    rewired = state.rewired
-    rewire_targets = state.rewire_targets
-    degree_credit = state.degree_credit
-    fresh = None
-    burst = faults is not None and churn_faults
-    if cfg.churn_leave_prob > 0.0 or burst:
-        p_leave = cfg.churn_leave_prob
-        if burst:
-            # independent composition with the configured Poisson churn:
-            # P(leave) = 1-(1-p_cfg)(1-p_burst) on burst rows — the draw
-            # itself keeps its key and shape (bit-identity across engines)
-            p_leave = 1.0 - (1.0 - p_leave) * (
-                1.0 - jnp.where(faults.burst, faults.leave, 0.0)
-            )
-        leave = alive & (jax.random.uniform(k_leave, alive.shape) < p_leave)
-        alive = alive & ~leave
-    if cfg.churn_join_prob > 0.0 or burst:
-        # vacant slots rejoin with fresh protocol state (jit-friendly churn,
-        # SURVEY.md §7.4: fixed slots + alive masks instead of per-round CSR
-        # rebuilds). Pad/sentinel slots (exists=False) never rejoin — they
-        # are not peers, and resurrecting them would dilute the coverage
-        # denominator with uninfectable degree-0 slots.
-        k_join, k_rw = jax.random.split(k_join)
-        p_join = cfg.churn_join_prob
-        if burst:
-            p_join = 1.0 - (1.0 - p_join) * (
-                1.0 - jnp.where(faults.burst, faults.join, 0.0)
-            )
-        join = (~alive) & state.exists & (
-            jax.random.uniform(k_join, alive.shape) < p_join
-        )
-        alive = alive | join
-        fresh = join
-        silent = silent & ~fresh
-        last_hb = jnp.where(fresh, rnd, last_hb)
-        declared_dead = declared_dead & ~fresh
-        if cfg.rewire_slots > 0 and state.col_idx.shape[0] > 0:
-            # power-law re-wiring: the arriving peer attaches its fresh
-            # edges degree-preferentially. A uniform index into the CSR
-            # endpoint list IS degree-proportional sampling — the
-            # repeated-endpoints trick of the reference's intended selector
-            # (demonstrate_powerlaw.py:5-39). An EDGELESS CSR (col_idx
-            # shape (0,), a static property) has no endpoints to draw:
-            # joiners rejoin on their slot's (empty) edges un-rewired
-            # instead of gathering from a zero-length array.
-            n, s = rewire_targets.shape
-            # draw indices in [0, row_ptr[-1]) — the REAL edge span — not
-            # [0, len(col_idx)): a re-materialized CSR (rematerialize_rewired)
-            # keeps a self-loop tail past row_ptr[-1] whose entries would
-            # bias endpoint draws toward one row. randint accepts the traced
-            # bound; a float32 uniform*e_real would quantize away most slots
-            # past 2^24 edges (10M-scale graphs have ~60M)
-            e_real = jnp.maximum(state.row_ptr[-1], 1)
-            cap = min(cfg.rewire_compact_cap, n) or None
-            if cap is None:
-                jrows = jnp.arange(n, dtype=jnp.int32)  # every row draws
-                draw_shape = (n, s)
-            else:
-                # only this round's joiners need draws — compact them into
-                # (cap,) rows so the endpoint gathers are O(cap) not O(N)
-                # (~38 ms of a 1M churn round, docs/kernel_profile_1m.md);
-                # joiners past cap rejoin on their slot's existing edges
-                jrows = jnp.nonzero(fresh, size=cap, fill_value=0)[0]
-                draw_shape = (cap, s)
-                jlive = jnp.arange(cap) < jnp.sum(fresh, dtype=jnp.int32)
-            draws = state.col_idx[
-                jax.random.randint(k_rw, draw_shape, 0, e_real)
-            ]
-            # a draw can land on a padding/sentinel edge slot (DeviceGraph
-            # CSRs point erased edges at the sentinel row) or on the
-            # rejoiner ITSELF (its neighbors' endpoints include it) — mark
-            # both -1 so fan-out substitution treats them as invalid: a
-            # self edge would waste fan-out draws and, once folded in by
-            # rematerialize_rewired, be dropped by partition_graph's
-            # src<dst dedup, silently shrinking the peer's degree
-            self_draw = draws == jrows.astype(draws.dtype)[:, None]
-            draws = jnp.where(state.exists[draws] & ~self_draw, draws, -1)
-            # membership-registry upkeep (growth/): degree_credit counts
-            # unfolded fresh IN-edges, so an overwrite of a rejoiner's
-            # stored targets must RELEASE the credit those edges granted
-            # (a previously grown/rewired peer's fresh edges vanish with
-            # the overwrite — without the release, phantom credit biases
-            # the preferential-attachment weights and the γ track, and
-            # breaks the fold invariant) and GRANT credit to the new
-            # draws. One (N, S)-index scatter pair, churn-join rounds
-            # with re-wiring only.
-            released = (fresh & rewired)[:, None] & (rewire_targets >= 0)
-            degree_credit = degree_credit.at[
-                jnp.where(released, rewire_targets, n).reshape(-1)
-            ].add(-1, mode="drop")
-            if cap is None:
-                degree_credit = degree_credit.at[
-                    jnp.where(fresh[:, None] & (draws >= 0), draws, n)
-                    .reshape(-1)
-                ].add(1, mode="drop")
-                rewire_targets = jnp.where(fresh[:, None], draws, rewire_targets)
-                rewired = rewired | fresh
-            else:
-                sel_rows = jnp.where(jlive, jrows, n)  # n = dropped
-                degree_credit = degree_credit.at[
-                    jnp.where(jlive[:, None] & (draws >= 0), draws, n)
-                    .reshape(-1)
-                ].add(1, mode="drop")
-                rewire_targets = rewire_targets.at[sel_rows].set(
-                    draws.astype(rewire_targets.dtype), mode="drop"
-                )
-                selected = jnp.zeros_like(fresh).at[sel_rows].set(
-                    True, mode="drop"
-                )
-                # over-cap joiners rejoin on their slot's existing CSR edges:
-                # clear a previously-rewired slot's flag and stale targets or
-                # the rejoiner would inherit the DEPARTED occupant's fresh
-                # edge as its only link (its CSR rows stay masked while
-                # rewired is True)
-                unselected = fresh & ~selected
-                rewired = (rewired & ~unselected) | (fresh & selected)
-                rewire_targets = jnp.where(
-                    unselected[:, None], -1, rewire_targets
-                )
-
-    # --- growth admission (row-level; growth/engine.py) -------------------
-    exists = state.exists
-    join_round = state.join_round
-    admitted_by = state.admitted_by
-    if growth is not None:
-        from tpu_gossip.growth.engine import apply_growth
-
-        if cfg.rewire_slots < growth.attach_m:
-            raise ValueError(
-                f"growth.attach_m={growth.attach_m} needs "
-                f"cfg.rewire_slots >= {growth.attach_m} — growth edges "
-                "ride the re-wiring plane's delivery paths"
-            )
-
-        jb = (
-            faults.join_burst
-            if faults is not None
-            else jnp.zeros((), dtype=jnp.int32)
-        )
-        grown = apply_growth(
-            growth, state.rng, rnd, jb,
-            row_ptr=state.row_ptr,
-            exists=exists, alive=alive, silent=silent, last_hb=last_hb,
-            declared_dead=declared_dead, rewired=rewired,
-            rewire_targets=rewire_targets, join_round=join_round,
-            admitted_by=admitted_by, degree_credit=degree_credit,
-        )
-        exists = grown["exists"]
-        alive = grown["alive"]
-        silent = grown["silent"]
-        last_hb = grown["last_hb"]
-        declared_dead = grown["declared_dead"]
-        rewired = grown["rewired"]
-        rewire_targets = grown["rewire_targets"]
-        join_round = grown["join_round"]
-        admitted_by = grown["admitted_by"]
-        degree_credit = grown["degree_credit"]
-
-    # --- streaming age-out (traffic/): slot columns past TTL recycle ------
-    # the expired mask folds into the fused tail below like the churn
-    # fresh mask; the delay buffer drops the recycled columns' held bits
-    # (they belong to the recycled message). stream=None leaves the lease
-    # table and the buffer carried untouched — the no-stream hot path.
-    expired = None
-    slot_lease = state.slot_lease
-    held = state.fault_held if fault_held is None else fault_held
-    if stream is not None:
-        from tpu_gossip.traffic.engine import slot_expiry
-
-        expired = slot_expiry(slot_lease, rnd, stream.ttl)
-        slot_lease = jnp.where(expired, -1, slot_lease)
-        held = held & ~expired[None, :]
-
-    # --- fused slot tail: dedup merge + latch + SIR + fresh resets --------
-    seen, forwarded, infected_round, recovered = round_tail(
-        state.seen, state.forwarded, state.infected_round, state.recovered,
-        incoming, receptive, transmit, fresh, rnd,
-        forward_once=cfg.forward_once,
-        sir_recover_rounds=cfg.sir_recover_rounds,
-        expired=expired,
-        impl=tail,
+    values = {
+        # state slices (initial carries)
+        "row_ptr": state.row_ptr, "col_idx": state.col_idx,
+        "seen": state.seen, "forwarded": state.forwarded,
+        "infected_round": state.infected_round,
+        "recovered": state.recovered, "exists": state.exists,
+        "alive": state.alive, "silent": state.silent,
+        "last_hb": state.last_hb, "declared_dead": state.declared_dead,
+        "rewired": state.rewired, "rewire_targets": state.rewire_targets,
+        "join_round": state.join_round, "admitted_by": state.admitted_by,
+        "degree_credit": state.degree_credit,
+        "slot_lease": state.slot_lease, "control_lvl": state.control_lvl,
+        "rng": state.rng,
+        # dissemination products + round inputs
+        "incoming": incoming, "transmit": transmit, "receptive": receptive,
+        "rnd": rnd, "k_leave": k_leave, "k_join": k_join,
+        "faults": faults, "fstats": fstats, "rctl": rctl,
+        "seen_prev": state.seen,
+        "held": state.fault_held if fault_held is None else fault_held,
+        # defaults the optional stages overwrite
+        "fresh": None, "expired": None, "stel": None, "ctel": None,
+    }
+    values = run_stages(
+        build_round_stages(
+            cfg, tail=tail, has_faults=faults is not None,
+            churn_faults=churn_faults, growth=growth, stream=stream,
+            control=control,
+        ),
+        values,
     )
 
-    # --- streaming injection (traffic/): post-tail, so a round-r arrival
-    # first transmits in round r+1 and a just-recycled slot is
-    # immediately re-leasable — the sliding window advances in one round
-    stel = None
-    if stream is not None:
-        from tpu_gossip.traffic.engine import apply_stream
-
-        seen, infected_round, slot_lease, stel = apply_stream(
-            stream, state.rng, rnd, jnp.sum(expired, dtype=jnp.int32),
-            seen=seen, infected_round=infected_round,
-            slot_lease=slot_lease, row_ptr=state.row_ptr,
-            col_idx=state.col_idx, exists=exists, alive=alive,
-            declared_dead=declared_dead,
-        )
-
-    # --- adaptive control (control/): AIMD level update + PeerSwap --------
-    # runs LAST so the feedback reads the round's final liveness/lease
-    # tables and the refresh acts on the post-churn/growth re-wiring
-    # plane. control=None carries the cursor untouched — the no-control
-    # hot path.
-    control_lvl = state.control_lvl
-    ctel = None
-    if control is not None:
-        from tpu_gossip.control.engine import apply_control
-
-        control_lvl, rewire_targets, degree_credit, ctel = apply_control(
-            control, state.rng, rnd, rctl,
-            incoming=incoming, seen_prev=state.seen, seen=seen,
-            alive=alive, declared_dead=declared_dead, exists=exists,
-            rewired=rewired, rewire_targets=rewire_targets,
-            degree_credit=degree_credit, row_ptr=state.row_ptr,
-            col_idx=state.col_idx, slot_lease=slot_lease,
-            rewire_slots=cfg.rewire_slots, fstats=fstats,
-        )
-
+    if pipe_buf is not None and values["expired"] is not None:
+        # a recycled column's in-flight bits die with the lease, exactly
+        # like the delay buffer's (stream_ageout stage): the issue read
+        # the pre-expiry seen plane, so without this mask a retired
+        # message's bits would deliver into the column's NEW lease next
+        # round — cross-message contamination. Same-round delivery of
+        # the CONSUMED buffer is already guarded by the tail's expired
+        # mask; this guards the STORED one.
+        pipe_buf = pipe_buf & ~values["expired"][None, :]
     new_state = SwarmState(
         row_ptr=state.row_ptr,
         col_idx=state.col_idx,
-        seen=seen,
-        forwarded=forwarded,
-        infected_round=infected_round,
-        recovered=recovered,
-        exists=exists,
-        alive=alive,
-        silent=silent,
-        last_hb=last_hb,
-        declared_dead=declared_dead,
-        rewired=rewired,
-        rewire_targets=rewire_targets,
-        fault_held=held,
-        join_round=join_round,
-        admitted_by=admitted_by,
-        degree_credit=degree_credit,
-        slot_lease=slot_lease,
-        control_lvl=control_lvl,
+        seen=values["seen"],
+        forwarded=values["forwarded"],
+        infected_round=values["infected_round"],
+        recovered=values["recovered"],
+        exists=values["exists"],
+        alive=values["alive"],
+        silent=values["silent"],
+        last_hb=values["last_hb"],
+        declared_dead=values["declared_dead"],
+        rewired=values["rewired"],
+        rewire_targets=values["rewire_targets"],
+        fault_held=values["held"],
+        join_round=values["join_round"],
+        admitted_by=values["admitted_by"],
+        degree_credit=values["degree_credit"],
+        slot_lease=values["slot_lease"],
+        control_lvl=values["control_lvl"],
+        pipe_buf=state.pipe_buf if pipe_buf is None else pipe_buf,
         rng=key,
         round=rnd,
     )
     return new_state, _stats(new_state, msgs_sent, fstats, growth, stream,
-                             stel, ctel)
+                             values["stel"], values["ctel"])
 
 
 def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
-    scenario=None, growth=None, stream=None, control=None,
+    scenario=None, growth=None, stream=None, control=None, pipeline=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -1164,56 +974,36 @@ def gossip_round(
     registered ``CONTROL_STREAM_SALT`` stream, so ``control=None`` — and
     a zero-adjustment spec — reproduce the uncontrolled protocol
     trajectory bit for bit. Composes with all three planes above.
+
+    ``pipeline`` (a :class:`~tpu_gossip.sim.stages.PipelineSpec`)
+    selects the pipelined schedule (docs/pipelined_rounds.md): depth 1
+    double-buffers the exchange through ``state.pipe_buf`` (delivery one
+    round stale, issue-side semantics unchanged); depth 0 — and
+    ``pipeline=None`` — is the serial schedule bit for bit. On the
+    local engine the buffered "exchange" is the dissemination product
+    itself (there is no collective to overlap), which is exactly what
+    makes PIPELINED local-vs-mesh bit-identity testable.
     """
-    validate_rewire_width(state, cfg)
-    rnd = state.round + 1
-    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
-    _, transmitter, receptive = compute_roles(state)
-    transmit = transmit_bitmap(state, cfg, transmitter)
-    rctl = None
-    if control is not None:
-        from tpu_gossip.control.engine import control_round
+    from tpu_gossip.sim.stages import run_protocol_round
 
-        rctl = control_round(control, state,
-                             want_needy=cfg.mode == "push_pull")
-    if scenario is None:
-        incoming, msgs_sent = _disseminate_local(
-            state, cfg, transmit, transmitter, receptive, k_push, k_pull,
-            plan, rctl,
-        )
-        return advance_round(
-            state, cfg, incoming, msgs_sent, transmit, rnd, key, k_leave,
-            k_join, receptive, tail=tail, growth=growth, stream=stream,
-            control=control, rctl=rctl,
-        )
-    from tpu_gossip.faults.inject import scenario_dissemination
+    def disseminate(tx, tr, rc, kp, kq, rctl):
+        return _disseminate_local(state, cfg, tx, tr, rc, kp, kq, plan, rctl)
 
-    def deliver(tx, tr, rc, k_dpush, k_dpull):
-        return _disseminate_local(
-            state, cfg, tx, tr, rc, k_dpush, k_dpull, plan, rctl
-        )
-
-    incoming, msgs_sent, tx_eff, held, telem, rf = scenario_dissemination(
-        scenario, state, rnd, transmit, transmitter, receptive,
-        k_push, k_pull, deliver,
-    )
-    return advance_round(
-        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
-        receptive, tail=tail, faults=rf, churn_faults=scenario.has_churn,
-        fault_held=held, fstats=telem, growth=growth, stream=stream,
-        control=control, rctl=rctl,
+    return run_protocol_round(
+        state, cfg, disseminate, tail=tail, scenario=scenario,
+        growth=growth, stream=stream, control=control, pipeline=pipeline,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "num_rounds", "tail"),
+    static_argnames=("cfg", "num_rounds", "tail", "pipeline"),
     donate_argnames=("state",),
 )
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
     tail: str = "fused", scenario=None, growth=None, stream=None,
-    control=None,
+    control=None, pipeline=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -1239,7 +1029,8 @@ def simulate(
     def body(carry, _):
         nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
                                   scenario=scenario, growth=growth,
-                                  stream=stream, control=control)
+                                  stream=stream, control=control,
+                                  pipeline=pipeline)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -1247,7 +1038,7 @@ def simulate(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_rounds", "slot", "tail"),
+    static_argnames=("cfg", "max_rounds", "slot", "tail", "pipeline"),
     donate_argnames=("state",),
 )
 def run_until_coverage(
@@ -1262,6 +1053,7 @@ def run_until_coverage(
     growth=None,
     stream=None,
     control=None,
+    pipeline=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -1286,7 +1078,8 @@ def run_until_coverage(
 
     def body(s: SwarmState) -> SwarmState:
         nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
-                              growth=growth, stream=stream, control=control)
+                              growth=growth, stream=stream, control=control,
+                              pipeline=pipeline)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

@@ -1,0 +1,649 @@
+"""The round as an explicit stage DAG — declared carries, one driver.
+
+Before this module, ``advance_round`` was one hand-ordered function and
+every engine (local XLA/kernel, bucketed mesh, matching mesh) re-threaded
+the fault head, growth, stream, and control stages around it by hand —
+five call sites that each had to agree on which state slices a stage
+reads and writes. Here each stage DECLARES its carries once:
+
+- a :class:`Stage` names the context keys it ``reads`` and ``writes``;
+- :func:`run_stages` executes the declared order, enforcing at TRACE TIME
+  that a stage touches nothing it didn't declare (an undeclared read or
+  write is a ``ValueError`` during tracing, not a silent carry leak);
+- :func:`build_round_stages` composes the post-dissemination stages for a
+  config (liveness → churn → growth → stream age-out → fused tail →
+  stream injection → control), with absent subsystems compiled out
+  exactly as before — the stage list is built at trace time, so a stage
+  that doesn't exist costs nothing;
+- :func:`run_protocol_round` is the shared per-engine driver: every
+  engine hands it ONE dissemination closure and the driver runs the
+  scenario head, the control resolve, the (optional) pipeline swap, and
+  the stage DAG identically — the round structure exists once.
+
+The declared-carry enforcement is pure Python over the traced values
+(dict bookkeeping): zero runtime cost, and the jaxpr it produces is
+op-for-op the one the hand-ordered sequence produced — the refactor is
+bit-exact by construction (the tier-1 parity matrix pins it).
+
+Pipelined rounds (docs/pipelined_rounds.md): :func:`compile_pipeline`
+builds a :class:`PipelineSpec`. At ``depth=1`` the driver DOUBLE-BUFFERS
+the exchange: the dissemination (collective) for the CURRENT transmit
+plane is issued into ``SwarmState.pipe_buf`` while the PREVIOUS round's
+buffered exchange delivers through the protocol tail — the collective
+and the shard-local tail/liveness/stats have no data dependency inside
+the round, so XLA can overlap them (async collectives on a real mesh).
+Delivery is one round stale — the staleness *The Algorithm of Pipelined
+Gossiping* shows the epidemic tolerates — and everything else (billing,
+forward-once latching, fault telemetry, control feedback) stays
+issue-side, so the ONLY divergence from serial is the delivered plane's
+age. ``depth=0`` reproduces the serial round bit for bit (the same
+contract pattern as ``control=None`` and zero-rate streams).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Stage",
+    "StageView",
+    "run_stages",
+    "build_round_stages",
+    "run_protocol_round",
+    "effective_transmit_planes",
+    "PipelineSpec",
+    "compile_pipeline",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """Compiled pipelined-execution contract (jit-static, hashable).
+
+    ``depth=0`` is the serial schedule — bit-identical to
+    ``pipeline=None`` on every engine (test-pinned, the ``control=None``
+    contract pattern). ``depth=1`` double-buffers the exchange through
+    ``SwarmState.pipe_buf``: round *t* delivers round *t-1*'s issued
+    plane and issues round *t*'s — one round of delivery staleness,
+    full collective/compute overlap. Deeper pipelines would add
+    staleness without adding overlap (one exchange is in flight per
+    round either way), so the depth is capped at 1.
+    """
+
+    depth: int = 1
+
+    def __post_init__(self):
+        if self.depth not in (0, 1):
+            raise ValueError(
+                f"pipeline depth must be 0 (serial, bit-identical) or 1 "
+                f"(double-buffered exchange); got {self.depth}"
+            )
+
+
+def compile_pipeline(depth: int = 1) -> PipelineSpec:
+    """Validate + freeze a pipelined-execution spec (see PipelineSpec)."""
+    return PipelineSpec(depth=depth)
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One post-dissemination round stage with DECLARED carries.
+
+    ``fn(view) -> dict`` reads carries through the guarded ``view``
+    (undeclared reads raise at trace time) and returns exactly its
+    declared writes. Declarations are the carry contract the driver
+    enforces — the replacement for five engines hand-threading the same
+    slices.
+    """
+
+    name: str
+    reads: tuple[str, ...]
+    writes: tuple[str, ...]
+    fn: Callable[["StageView"], dict]
+
+
+class StageView(Mapping):
+    """Read guard over the carry dict: a stage sees only what it declared."""
+
+    def __init__(self, values: dict, stage: Stage):
+        self._values = values
+        self._stage = stage
+
+    def __getitem__(self, key: str):
+        if key not in self._stage.reads:
+            raise ValueError(
+                f"stage {self._stage.name!r} reads carry {key!r} without "
+                f"declaring it — add it to reads={self._stage.reads}"
+            )
+        return self._values[key]
+
+    def __iter__(self):
+        return iter(self._stage.reads)
+
+    def __len__(self):
+        return len(self._stage.reads)
+
+
+def run_stages(stages: tuple[Stage, ...], values: dict) -> dict:
+    """Execute the stage DAG over the carry dict (trace-time driver).
+
+    Stages run in declared order (the DAG is linearized at build time —
+    each stage's reads must be satisfied by the initial carries or an
+    earlier stage's writes). Enforced per stage: every declared read
+    exists, every returned key was declared. Mutates and returns
+    ``values``.
+    """
+    for st in stages:
+        missing = [k for k in st.reads if k not in values]
+        if missing:
+            raise ValueError(
+                f"stage {st.name!r} declares reads {missing} that no "
+                f"earlier stage or initial carry provides — stage order "
+                f"or declarations are wrong"
+            )
+        out = st.fn(StageView(values, st))
+        undeclared = [k for k in out if k not in st.writes]
+        if undeclared:
+            raise ValueError(
+                f"stage {st.name!r} wrote undeclared carries {undeclared} "
+                f"— add them to writes={st.writes}"
+            )
+        values.update(out)
+    return values
+
+
+# ---------------------------------------------------------------------------
+# stage builders — each transplants one block of the historical
+# advance_round body verbatim (same ops, same key discipline), with its
+# carry contract made explicit
+
+
+def _liveness_stage(cfg, has_faults: bool) -> Stage:
+    """Heartbeat emission + failure detection (row-level O(N)).
+
+    A blacked-out node is cut off from the heartbeat plane too: it emits
+    nothing anyone hears and answers no detector probe — exactly a
+    silent peer for the phase's duration; dead declarations it earns
+    persist (the reference's registry purge has no resurrection either).
+    """
+    from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
+
+    reads = ("silent", "alive", "declared_dead", "last_hb", "rnd") + (
+        ("faults",) if has_faults else ()
+    )
+
+    def fn(ctx):
+        silent_now = (
+            ctx["silent"] | ctx["faults"].blackout
+            if has_faults
+            else ctx["silent"]
+        )
+        last_hb = emit_heartbeats(
+            ctx["last_hb"], ctx["alive"], silent_now, ctx["declared_dead"],
+            ctx["rnd"], cfg.hb_period_rounds,
+        )
+        last_hb, declared_dead = detect_failures(
+            last_hb, ctx["alive"], silent_now, ctx["declared_dead"],
+            ctx["rnd"], cfg.timeout_rounds, cfg.detect_period_rounds,
+        )
+        return {"last_hb": last_hb, "declared_dead": declared_dead}
+
+    return Stage("liveness", reads, ("last_hb", "declared_dead"), fn)
+
+
+def _churn_stage(cfg, burst: bool) -> Stage:
+    """Poisson churn, row-level half (BASELINE config 5) + re-wiring draws.
+
+    The fresh-slot SLOT-ARRAY resets are deferred to the fused tail (they
+    commute with the dedup merge: the join draws read only row-level
+    state, and the tail folds ``& ~fresh`` into the producing expressions
+    instead of a second sweep over the slot arrays). With ``burst`` the
+    scenario's leave/join probabilities fold into the SAME draws as
+    per-node thresholds — keys and shapes untouched, so engines stay
+    bit-identical and a quiescent phase changes nothing.
+    """
+    reads = (
+        "alive", "silent", "exists", "last_hb", "declared_dead", "rewired",
+        "rewire_targets", "degree_credit", "row_ptr", "col_idx", "rnd",
+        "k_leave", "k_join",
+    ) + (("faults",) if burst else ())
+    writes = (
+        "alive", "silent", "last_hb", "declared_dead", "rewired",
+        "rewire_targets", "degree_credit", "fresh",
+    )
+
+    def fn(ctx):
+        alive = ctx["alive"]
+        silent = ctx["silent"]
+        last_hb = ctx["last_hb"]
+        declared_dead = ctx["declared_dead"]
+        rewired = ctx["rewired"]
+        rewire_targets = ctx["rewire_targets"]
+        degree_credit = ctx["degree_credit"]
+        faults = ctx["faults"] if burst else None
+        k_join = ctx["k_join"]
+        fresh = None
+        if cfg.churn_leave_prob > 0.0 or burst:
+            p_leave = cfg.churn_leave_prob
+            if burst:
+                # independent composition with the configured Poisson
+                # churn: P(leave) = 1-(1-p_cfg)(1-p_burst) on burst rows —
+                # the draw itself keeps its key and shape (bit-identity
+                # across engines)
+                p_leave = 1.0 - (1.0 - p_leave) * (
+                    1.0 - jnp.where(faults.burst, faults.leave, 0.0)
+                )
+            leave = alive & (
+                jax.random.uniform(ctx["k_leave"], alive.shape) < p_leave
+            )
+            alive = alive & ~leave
+        if cfg.churn_join_prob > 0.0 or burst:
+            # vacant slots rejoin with fresh protocol state (jit-friendly
+            # churn, SURVEY.md §7.4: fixed slots + alive masks instead of
+            # per-round CSR rebuilds). Pad/sentinel slots (exists=False)
+            # never rejoin — they are not peers, and resurrecting them
+            # would dilute the coverage denominator with uninfectable
+            # degree-0 slots.
+            k_join, k_rw = jax.random.split(k_join)
+            p_join = cfg.churn_join_prob
+            if burst:
+                p_join = 1.0 - (1.0 - p_join) * (
+                    1.0 - jnp.where(faults.burst, faults.join, 0.0)
+                )
+            join = (~alive) & ctx["exists"] & (
+                jax.random.uniform(k_join, alive.shape) < p_join
+            )
+            alive = alive | join
+            fresh = join
+            silent = silent & ~fresh
+            last_hb = jnp.where(fresh, ctx["rnd"], last_hb)
+            declared_dead = declared_dead & ~fresh
+            if cfg.rewire_slots > 0 and ctx["col_idx"].shape[0] > 0:
+                # power-law re-wiring: the arriving peer attaches its
+                # fresh edges degree-preferentially. A uniform index into
+                # the CSR endpoint list IS degree-proportional sampling —
+                # the repeated-endpoints trick of the reference's intended
+                # selector (demonstrate_powerlaw.py:5-39). An EDGELESS CSR
+                # (col_idx shape (0,), a static property) has no endpoints
+                # to draw: joiners rejoin on their slot's (empty) edges
+                # un-rewired instead of gathering from a zero-length array.
+                n, s = rewire_targets.shape
+                # draw indices in [0, row_ptr[-1]) — the REAL edge span —
+                # not [0, len(col_idx)): a re-materialized CSR keeps a
+                # self-loop tail past row_ptr[-1] whose entries would bias
+                # endpoint draws toward one row. randint accepts the
+                # traced bound; a float32 uniform*e_real would quantize
+                # away most slots past 2^24 edges (10M-scale graphs have
+                # ~60M)
+                e_real = jnp.maximum(ctx["row_ptr"][-1], 1)
+                cap = min(cfg.rewire_compact_cap, n) or None
+                if cap is None:
+                    jrows = jnp.arange(n, dtype=jnp.int32)  # every row draws
+                    draw_shape = (n, s)
+                else:
+                    # only this round's joiners need draws — compact them
+                    # into (cap,) rows so the endpoint gathers are O(cap)
+                    # not O(N) (~38 ms of a 1M churn round,
+                    # docs/kernel_profile_1m.md); joiners past cap rejoin
+                    # on their slot's existing edges
+                    jrows = jnp.nonzero(fresh, size=cap, fill_value=0)[0]
+                    draw_shape = (cap, s)
+                    jlive = jnp.arange(cap) < jnp.sum(fresh, dtype=jnp.int32)
+                draws = ctx["col_idx"][
+                    jax.random.randint(k_rw, draw_shape, 0, e_real)
+                ]
+                # a draw can land on a padding/sentinel edge slot
+                # (DeviceGraph CSRs point erased edges at the sentinel
+                # row) or on the rejoiner ITSELF (its neighbors' endpoints
+                # include it) — mark both -1 so fan-out substitution
+                # treats them as invalid: a self edge would waste fan-out
+                # draws and, once folded in by rematerialize_rewired, be
+                # dropped by partition_graph's src<dst dedup, silently
+                # shrinking the peer's degree
+                self_draw = draws == jrows.astype(draws.dtype)[:, None]
+                draws = jnp.where(
+                    ctx["exists"][draws] & ~self_draw, draws, -1
+                )
+                # membership-registry upkeep (growth/): degree_credit
+                # counts unfolded fresh IN-edges, so an overwrite of a
+                # rejoiner's stored targets must RELEASE the credit those
+                # edges granted and GRANT credit to the new draws. One
+                # (N, S)-index scatter pair, churn-join rounds with
+                # re-wiring only.
+                released = (fresh & rewired)[:, None] & (rewire_targets >= 0)
+                degree_credit = degree_credit.at[
+                    jnp.where(released, rewire_targets, n).reshape(-1)
+                ].add(-1, mode="drop")
+                if cap is None:
+                    degree_credit = degree_credit.at[
+                        jnp.where(fresh[:, None] & (draws >= 0), draws, n)
+                        .reshape(-1)
+                    ].add(1, mode="drop")
+                    rewire_targets = jnp.where(
+                        fresh[:, None], draws, rewire_targets
+                    )
+                    rewired = rewired | fresh
+                else:
+                    sel_rows = jnp.where(jlive, jrows, n)  # n = dropped
+                    degree_credit = degree_credit.at[
+                        jnp.where(jlive[:, None] & (draws >= 0), draws, n)
+                        .reshape(-1)
+                    ].add(1, mode="drop")
+                    rewire_targets = rewire_targets.at[sel_rows].set(
+                        draws.astype(rewire_targets.dtype), mode="drop"
+                    )
+                    selected = jnp.zeros_like(fresh).at[sel_rows].set(
+                        True, mode="drop"
+                    )
+                    # over-cap joiners rejoin on their slot's existing CSR
+                    # edges: clear a previously-rewired slot's flag and
+                    # stale targets or the rejoiner would inherit the
+                    # DEPARTED occupant's fresh edge as its only link
+                    unselected = fresh & ~selected
+                    rewired = (rewired & ~unselected) | (fresh & selected)
+                    rewire_targets = jnp.where(
+                        unselected[:, None], -1, rewire_targets
+                    )
+        return {
+            "alive": alive, "silent": silent, "last_hb": last_hb,
+            "declared_dead": declared_dead, "rewired": rewired,
+            "rewire_targets": rewire_targets, "degree_credit": degree_credit,
+            "fresh": fresh,
+        }
+
+    return Stage("churn", reads, writes, fn)
+
+
+def _growth_stage(cfg, growth, has_faults: bool) -> Stage:
+    """Preferential-attachment admission (growth/engine.py), row-level.
+
+    Admits this round's join batch AFTER the churn draws from the
+    dedicated ``GROWTH_STREAM_SALT`` stream at global shape — the
+    protocol's 5-way split and the churn/fault draws are untouched, so an
+    exhausted or zero-join schedule reproduces the fixed-n trajectory bit
+    for bit. Admitted rows' slot arrays are already virgin (a
+    never-existed row was never receptive), so the fused tail needs no
+    extra reset sweep for them.
+    """
+    if cfg.rewire_slots < growth.attach_m:
+        raise ValueError(
+            f"growth.attach_m={growth.attach_m} needs "
+            f"cfg.rewire_slots >= {growth.attach_m} — growth edges "
+            "ride the re-wiring plane's delivery paths"
+        )
+    fields = (
+        "exists", "alive", "silent", "last_hb", "declared_dead", "rewired",
+        "rewire_targets", "join_round", "admitted_by", "degree_credit",
+    )
+    reads = ("rng", "rnd", "row_ptr") + fields + (
+        ("faults",) if has_faults else ()
+    )
+
+    def fn(ctx):
+        from tpu_gossip.growth.engine import apply_growth
+
+        jb = (
+            ctx["faults"].join_burst
+            if has_faults
+            else jnp.zeros((), dtype=jnp.int32)
+        )
+        grown = apply_growth(
+            growth, ctx["rng"], ctx["rnd"], jb,
+            row_ptr=ctx["row_ptr"],
+            **{f: ctx[f] for f in fields},
+        )
+        return {f: grown[f] for f in fields}
+
+    return Stage("growth", reads, fields, fn)
+
+
+def _stream_ageout_stage(stream) -> Stage:
+    """Slot columns past TTL recycle (traffic/): the expired mask folds
+    into the fused tail like the churn fresh mask; the delay buffer drops
+    the recycled columns' held bits (they belong to the recycled
+    message)."""
+
+    def fn(ctx):
+        from tpu_gossip.traffic.engine import slot_expiry
+
+        expired = slot_expiry(ctx["slot_lease"], ctx["rnd"], stream.ttl)
+        slot_lease = jnp.where(expired, -1, ctx["slot_lease"])
+        held = ctx["held"] & ~expired[None, :]
+        return {"expired": expired, "slot_lease": slot_lease, "held": held}
+
+    return Stage(
+        "stream_ageout",
+        ("slot_lease", "rnd", "held"),
+        ("expired", "slot_lease", "held"),
+        fn,
+    )
+
+
+def _tail_stage(cfg, tail: str) -> Stage:
+    """ONE fused traversal of the (N, M) slot arrays
+    (``kernels.round_tail``): dedup merge + infection latch + per-slot SIR
+    + churn fresh resets + stream expiry resets, each output materialized
+    once. ``tail`` selects the implementation (fused/reference/pallas) —
+    bit-identical all three."""
+    reads = (
+        "seen", "forwarded", "infected_round", "recovered", "incoming",
+        "receptive", "transmit", "fresh", "rnd", "expired",
+    )
+    writes = ("seen", "forwarded", "infected_round", "recovered")
+
+    def fn(ctx):
+        from tpu_gossip.kernels.round_tail import round_tail
+
+        seen, forwarded, infected_round, recovered = round_tail(
+            ctx["seen"], ctx["forwarded"], ctx["infected_round"],
+            ctx["recovered"], ctx["incoming"], ctx["receptive"],
+            ctx["transmit"], ctx["fresh"], ctx["rnd"],
+            forward_once=cfg.forward_once,
+            sir_recover_rounds=cfg.sir_recover_rounds,
+            expired=ctx["expired"],
+            impl=tail,
+        )
+        return {
+            "seen": seen, "forwarded": forwarded,
+            "infected_round": infected_round, "recovered": recovered,
+        }
+
+    return Stage("tail", reads, writes, fn)
+
+
+def _stream_inject_stage(stream) -> Stage:
+    """Streaming injection (traffic/), post-tail: a round-r arrival first
+    transmits in round r+1 and a just-recycled slot is immediately
+    re-leasable — the sliding window advances in one round."""
+    reads = (
+        "rng", "rnd", "expired", "seen", "infected_round", "slot_lease",
+        "row_ptr", "col_idx", "exists", "alive", "declared_dead",
+    )
+    writes = ("seen", "infected_round", "slot_lease", "stel")
+
+    def fn(ctx):
+        from tpu_gossip.traffic.engine import apply_stream
+
+        seen, infected_round, slot_lease, stel = apply_stream(
+            stream, ctx["rng"], ctx["rnd"],
+            jnp.sum(ctx["expired"], dtype=jnp.int32),
+            seen=ctx["seen"], infected_round=ctx["infected_round"],
+            slot_lease=ctx["slot_lease"], row_ptr=ctx["row_ptr"],
+            col_idx=ctx["col_idx"], exists=ctx["exists"],
+            alive=ctx["alive"], declared_dead=ctx["declared_dead"],
+        )
+        return {
+            "seen": seen, "infected_round": infected_round,
+            "slot_lease": slot_lease, "stel": stel,
+        }
+
+    return Stage("stream_inject", reads, writes, fn)
+
+
+def _control_stage(cfg, control) -> Stage:
+    """Adaptive control (control/), LAST: the AIMD level update reads the
+    round's final liveness/lease tables and the PeerSwap refresh acts on
+    the post-churn/growth re-wiring plane."""
+    reads = (
+        "rng", "rnd", "rctl", "incoming", "seen_prev", "seen", "alive",
+        "declared_dead", "exists", "rewired", "rewire_targets",
+        "degree_credit", "row_ptr", "col_idx", "slot_lease", "fstats",
+        "control_lvl",
+    )
+    writes = ("control_lvl", "rewire_targets", "degree_credit", "ctel")
+
+    def fn(ctx):
+        from tpu_gossip.control.engine import apply_control
+
+        control_lvl, rewire_targets, degree_credit, ctel = apply_control(
+            control, ctx["rng"], ctx["rnd"], ctx["rctl"],
+            incoming=ctx["incoming"], seen_prev=ctx["seen_prev"],
+            seen=ctx["seen"], alive=ctx["alive"],
+            declared_dead=ctx["declared_dead"], exists=ctx["exists"],
+            rewired=ctx["rewired"], rewire_targets=ctx["rewire_targets"],
+            degree_credit=ctx["degree_credit"], row_ptr=ctx["row_ptr"],
+            col_idx=ctx["col_idx"], slot_lease=ctx["slot_lease"],
+            rewire_slots=cfg.rewire_slots, fstats=ctx["fstats"],
+        )
+        return {
+            "control_lvl": control_lvl, "rewire_targets": rewire_targets,
+            "degree_credit": degree_credit, "ctel": ctel,
+        }
+
+    return Stage("control", reads, writes, fn)
+
+
+def build_round_stages(
+    cfg,
+    *,
+    tail: str = "fused",
+    has_faults: bool = False,
+    churn_faults: bool = False,
+    growth=None,
+    stream=None,
+    control=None,
+) -> tuple[Stage, ...]:
+    """The post-dissemination stage DAG for one config (trace-time).
+
+    Order is the protocol's: row-level liveness and churn first, growth
+    admission, then the stream age-out feeding the ONE fused slot-array
+    tail, post-tail injection, and the control feedback last. Absent
+    subsystems contribute no stage (their carries pass through the
+    initial values untouched) — the "absent planes cost nothing"
+    contract, now enforced structurally instead of by hand-ordered
+    ``if`` blocks in five engines.
+    """
+    burst = has_faults and churn_faults
+    stages: list[Stage] = [_liveness_stage(cfg, has_faults)]
+    if cfg.churn_leave_prob > 0.0 or cfg.churn_join_prob > 0.0 or burst:
+        stages.append(_churn_stage(cfg, burst))
+    if growth is not None:
+        stages.append(_growth_stage(cfg, growth, has_faults))
+    if stream is not None:
+        stages.append(_stream_ageout_stage(stream))
+    stages.append(_tail_stage(cfg, tail))
+    if stream is not None:
+        stages.append(_stream_inject_stage(stream))
+    if control is not None:
+        stages.append(_control_stage(cfg, control))
+    return tuple(stages)
+
+
+def effective_transmit_planes(state, cfg, scenario=None):
+    """(tx_eff, transmitter, receptive) for THIS round, as the driver
+    computes them — the analytic ICI counter's view of the exchange. The
+    ops duplicate the driver's mask math exactly (pure, same operands), so
+    XLA's CSE folds the recomputation away inside one jit."""
+    from tpu_gossip.sim import engine as _engine
+
+    _, transmitter, receptive = _engine.compute_roles(state)
+    transmit = _engine.transmit_bitmap(state, cfg, transmitter)
+    if scenario is not None and scenario.has_blackout:
+        rf = scenario.at_round(state.round + 1)
+        transmit = transmit & (~rf.blackout)[:, None]
+    return transmit, transmitter, receptive
+
+
+def run_protocol_round(
+    state,
+    cfg,
+    disseminate: Callable,
+    *,
+    tail: str = "fused",
+    scenario=None,
+    growth=None,
+    stream=None,
+    control=None,
+    pipeline: PipelineSpec | None = None,
+):
+    """One whole protocol round, engine-agnostic: the shared driver.
+
+    ``disseminate(tx, transmitter, receptive, k_push, k_pull, rctl) ->
+    (incoming, msgs_sent)`` is the engine's delivery core (local
+    XLA/kernel, bucketed mesh, matching mesh) — the ONLY thing an engine
+    contributes. The driver owns everything around it: rewire-width
+    validation, the 5-way key split, role masks, the control resolve, the
+    scenario head (``faults.inject.scenario_dissemination``), the
+    pipeline double-buffer swap, and the post-dissemination stage DAG via
+    ``sim.engine.advance_round``. Returns ``(new_state, RoundStats)``.
+
+    Pipelining (``pipeline.depth == 1``): the dissemination above ISSUES
+    round *t*'s exchange — masks, keys, faults, billing, forward-once
+    latching, and telemetry are all round *t*'s, identical to serial —
+    but the plane DELIVERED through the tail is the buffered exchange
+    issued at round *t-1* (``state.pipe_buf``), and the fresh exchange
+    replaces it. The issued collective and the consumed tail share no
+    data dependency inside the round, so the scheduler can overlap them.
+    Delivered bits are masked by the CURRENT round's receptive set (a
+    packet arriving after its receiver died or recovered is dropped —
+    ordinary network semantics). ``depth == 0`` (and ``pipeline=None``)
+    is the serial schedule, bit for bit.
+    """
+    from tpu_gossip.sim import engine as _engine
+
+    _engine.validate_rewire_width(state, cfg)
+    rnd = state.round + 1
+    key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
+    _, transmitter, receptive = _engine.compute_roles(state)
+    transmit = _engine.transmit_bitmap(state, cfg, transmitter)
+    rctl = None
+    if control is not None:
+        from tpu_gossip.control.engine import control_round
+
+        rctl = control_round(control, state,
+                             want_needy=cfg.mode == "push_pull")
+    if scenario is None:
+        incoming, msgs_sent = disseminate(
+            transmit, transmitter, receptive, k_push, k_pull, rctl
+        )
+        tx_eff, held, telem, rf = transmit, None, None, None
+    else:
+        from tpu_gossip.faults.inject import scenario_dissemination
+
+        incoming, msgs_sent, tx_eff, held, telem, rf = (
+            scenario_dissemination(
+                scenario, state, rnd, transmit, transmitter, receptive,
+                k_push, k_pull,
+                lambda tx, tr, rc, kp, kq: disseminate(
+                    tx, tr, rc, kp, kq, rctl
+                ),
+            )
+        )
+    pipe_buf = None
+    if pipeline is not None and pipeline.depth > 0:
+        # the double-buffer swap: deliver LAST round's issued exchange,
+        # carry this round's issue in flight. Everything issue-side
+        # (billing, tx_eff latching, fault telemetry, the held buffer)
+        # stays with the round that issued it.
+        incoming, pipe_buf = state.pipe_buf, incoming
+    return _engine.advance_round(
+        state, cfg, incoming, msgs_sent, tx_eff, rnd, key, k_leave, k_join,
+        receptive, tail=tail, faults=rf,
+        churn_faults=scenario is not None and scenario.has_churn,
+        fault_held=held, fstats=telem, growth=growth, stream=stream,
+        control=control, rctl=rctl, pipe_buf=pipe_buf,
+    )

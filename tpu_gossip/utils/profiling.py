@@ -107,6 +107,10 @@ def profile_round_stages(
     reps: int = 3,
     loop_lengths: tuple[int, int] = (4, 24),
     tails: tuple[str, ...] = ("reference", "fused"),
+    growth=None,
+    stream=None,
+    control=None,
+    transport_probe: tuple[int, int, int, int] | None = None,
 ) -> dict[str, float]:
     """Stage decomposition of one composed round, in seconds per round.
 
@@ -118,15 +122,34 @@ def profile_round_stages(
     - ``tail[<impl>]``        — the fused/reference/pallas protocol tail
       (kernels/round_tail.py) over one delivery's ``incoming``
     - ``liveness``            — heartbeat emission + failure-detector sweep
-    - ``stats``               — the per-round RoundStats reductions
+    - ``stats``               — the per-round RoundStats reductions (with
+      the active planes' tracks when growth/stream are passed)
     - ``rng``                 — the round's key splits
-    - ``full_round[<impl>]``  — the composed ``gossip_round`` per tail
+    - ``growth``              — the admission stage (growth/engine.
+      apply_growth: Gumbel-top-k draw + registry scatters), when a
+      compiled ``growth`` schedule is passed
+    - ``stream``              — the streaming stage (traffic/engine:
+      slot_expiry + apply_stream's landing scan), when a compiled
+      ``stream`` workload is passed
+    - ``control``             — the adaptive-control stage (control/
+      engine: the level resolve + AIMD feedback + PeerSwap refresh),
+      when a compiled ``control`` policy is passed
+    - ``transport_compact``   — the sparse transport's compaction
+      round-trip (dist/transport.py: occupancy header + compact index +
+      gather + scatter) over a synthetic ``transport_probe = (s, b, g,
+      budget)`` payload — the shard-local cost the sparse lane adds
+      around each collective
+    - ``full_round[<impl>]``  — the composed ``gossip_round`` per tail,
+      with every passed plane active
 
     ``tails`` picks the tail implementations measured (add "pallas" for the
     single-launch kernel — interpret-mode on CPU, so only meaningful on
     TPU). Stage sums need not equal the full round: XLA fuses across stage
     boundaries inside the composed round; the decomposition bounds each
-    stage's isolated cost, the composed rows measure reality.
+    stage's isolated cost, the composed rows measure reality. The
+    per-stage table is what attributes a pipelined round's overlap win
+    (docs/pipelined_rounds.md): ``delivery`` is the issue the collective
+    hides behind, everything else is the shard-local work it hides in.
     """
     import jax
     import jax.numpy as jnp
@@ -196,7 +219,7 @@ def profile_round_stages(
         return fold(c, hb, dead)
 
     def t_stats(i, c, st):
-        stats = engine._stats(st, i)
+        stats = engine._stats(st, i, None, growth, stream)
         return fold(c, stats.msgs_sent, stats.n_infected, stats.n_alive) ^ (
             stats.coverage > 0.5
         ).astype(jnp.int32)
@@ -205,9 +228,71 @@ def profile_round_stages(
         keys = jax.random.split(jax.random.fold_in(jax.random.key(2), i), 5)
         return fold(c, jax.random.key_data(keys)[..., 0].astype(jnp.int32))
 
+    def t_growth(i, c, st, gp):
+        from tpu_gossip.growth.engine import apply_growth
+
+        grown = apply_growth(
+            gp, jax.random.fold_in(st.rng, i), i,
+            jnp.zeros((), dtype=jnp.int32),
+            row_ptr=st.row_ptr, exists=st.exists, alive=st.alive,
+            silent=st.silent, last_hb=st.last_hb,
+            declared_dead=st.declared_dead, rewired=st.rewired,
+            rewire_targets=st.rewire_targets, join_round=st.join_round,
+            admitted_by=st.admitted_by, degree_credit=st.degree_credit,
+        )
+        return fold(c, grown["exists"], grown["join_round"],
+                    grown["degree_credit"])
+
+    def t_stream(i, c, st, sp):
+        from tpu_gossip.traffic.engine import apply_stream, slot_expiry
+
+        expired = slot_expiry(st.slot_lease, i, sp.ttl)
+        lease = jnp.where(expired, -1, st.slot_lease)
+        seen, infected_round, lease, stel = apply_stream(
+            sp, jax.random.fold_in(st.rng, i), i,
+            jnp.sum(expired, dtype=jnp.int32),
+            seen=st.seen, infected_round=st.infected_round,
+            slot_lease=lease, row_ptr=st.row_ptr, col_idx=st.col_idx,
+            exists=st.exists, alive=st.alive,
+            declared_dead=st.declared_dead,
+        )
+        return fold(c, seen, infected_round, lease, stel.injected)
+
+    def t_control(i, c, st, inc, cp):
+        from tpu_gossip.control.engine import apply_control, control_round
+
+        rctl = control_round(cp, st,
+                             want_needy=cfg.mode == "push_pull")
+        lvl, tgts, credit, ctel = apply_control(
+            cp, jax.random.fold_in(st.rng, i), i, rctl,
+            incoming=inc, seen_prev=st.seen, seen=st.seen | inc,
+            alive=st.alive, declared_dead=st.declared_dead,
+            exists=st.exists, rewired=st.rewired,
+            rewire_targets=st.rewire_targets,
+            degree_credit=st.degree_credit, row_ptr=st.row_ptr,
+            col_idx=st.col_idx, slot_lease=st.slot_lease,
+            rewire_slots=cfg.rewire_slots, fstats=None,
+        )
+        return fold(c, lvl, tgts, credit, ctel.fanout)
+
+    def t_transport(i, c, payload):
+        from tpu_gossip.dist.transport import (
+            compact_index, gather_compact, occupancy_counts,
+            scatter_compact,
+        )
+
+        _, b_probe, _, budget = transport_probe
+        occ = (payload != 0).any(-1)
+        counts = occupancy_counts(occ)
+        idx = compact_index(occ, budget)
+        back = scatter_compact(idx, gather_compact(payload, idx), b_probe)
+        return fold(c, counts, back)
+
     def round_body(impl):
-        def body(i, s, pl):
-            nxt, _ = engine.gossip_round(s, cfg, pl, tail=impl)
+        def body(i, s, pl, gp, sp, cp):
+            nxt, _ = engine.gossip_round(s, cfg, pl, tail=impl,
+                                         growth=gp, stream=sp,
+                                         control=cp)
             return nxt
 
         return body
@@ -228,9 +313,40 @@ def profile_round_stages(
     )
     stages["stats"] = slope_time(t_stats, zero, n1, n2, reps, operands=(state,))
     stages["rng"] = slope_time(t_rng, zero, n1, n2, reps)
+    # the compiled plans ride as OPERANDS like every other device input
+    # (this file's own rule: closure-captured arrays become XLA constants
+    # and melt compile time into constant folding — a CompiledStream's
+    # origin table is (n_real,) device data)
+    if growth is not None:
+        stages["growth"] = slope_time(
+            t_growth, zero, n1, n2, reps, operands=(state, growth)
+        )
+    if stream is not None:
+        stages["stream"] = slope_time(
+            t_stream, zero, n1, n2, reps, operands=(state, stream)
+        )
+    if control is not None:
+        stages["control"] = slope_time(
+            t_control, zero, n1, n2, reps, operands=(state, incoming, control)
+        )
+    if transport_probe is not None:
+        s_probe, b_probe, g_probe, _budget = transport_probe
+        # a plausibly-sparse synthetic payload (~1/8 occupancy — the
+        # compact lane's design point): nonzero words where the mask hits
+        k_probe = jax.random.key(29)
+        occ_mask = (
+            jax.random.uniform(k_probe, (s_probe, b_probe, 1)) < 0.125
+        )
+        payload = jnp.where(
+            occ_mask, jnp.int32(0x5A5A5A5A), jnp.int32(0)
+        ) | jnp.zeros((s_probe, b_probe, g_probe), dtype=jnp.int32)
+        stages["transport_compact"] = slope_time(
+            t_transport, zero, n1, n2, reps, operands=(payload,)
+        )
     for impl in tails:
         stages[f"full_round[{impl}]"] = slope_time(
-            round_body(impl), state, n1, n2, reps, operands=(plan,)
+            round_body(impl), state, n1, n2, reps,
+            operands=(plan, growth, stream, control),
         )
     return stages
 
