@@ -942,13 +942,16 @@ def bench_pipeline(n: int, horizon: int = 24, reps: int = 1):
 def _lint_status(deep: bool = True) -> dict:
     """graftlint verdict for the tree being benchmarked. AST rules run
     in-process (sub-second); the combined run — rules + contract audit +
-    jaxpr deep tier — runs in a SUBPROCESS, because its entry-point
-    matrix needs an 8-CPU mesh and this process's device layout must stay
-    whatever the operator configured for the bench. ``lint_deep_s`` is
-    that combined wall time, the same quantity the CI lint-deep job
-    budgets (<120 s); ``deep=False`` skips the subprocess (fast unit
-    tests). Never raises: a crashed linter is itself recorded, not
-    silently dropped."""
+    jaxpr deep tier + graftmem memory tier — runs in a SUBPROCESS,
+    because its entry-point matrix needs an 8-CPU mesh and this process's
+    device layout must stay whatever the operator configured for the
+    bench. ``lint_deep_s`` is that combined wall time, the same quantity
+    the CI lint-deep job budgets (<120 s); ``mem_audit`` is the memory
+    tier's record — per-entry bytes/peer over the traced matrix, the
+    registry-derived state bytes/peer at 1M (the ROADMAP's tracked
+    metric), and the auditor's own wall seconds. ``deep=False`` skips
+    the subprocess (fast unit tests). Never raises: a crashed linter is
+    itself recorded, not silently dropped."""
     out: dict
     try:
         from tpu_gossip.analysis import run_repo_lint
@@ -977,7 +980,7 @@ def _lint_status(deep: bool = True) -> dict:
         env.setdefault("JAX_PLATFORMS", "cpu")
         t0 = time.perf_counter()
         proc = subprocess.run(
-            [sys.executable, "-m", "tpu_gossip.analysis", "--deep",
+            [sys.executable, "-m", "tpu_gossip.analysis", "--deep", "--mem",
              "--format=json"],
             capture_output=True, text=True, timeout=600, env=env,
         )
@@ -985,6 +988,40 @@ def _lint_status(deep: bool = True) -> dict:
         out["lint_deep_s"] = round(time.perf_counter() - t0, 1)
         out["lint"]["deep_clean"] = bool(rep["clean"]) and proc.returncode == 0
         out["lint"]["deep_elapsed_seconds"] = rep.get("elapsed_seconds")
+        mem = rep.get("mem_report") or {}
+        # the narrowed planes' measured win, from the declared registry:
+        # bytes/peer each sub-int32 integer plane saves at the headline
+        # shape vs the int32 it narrowed from (join_round/slot_lease led;
+        # the table grows as PLANES narrows further)
+        import numpy as _np
+
+        from tpu_gossip.core.state import PLANES, state_plane_bytes
+
+        plane_b = state_plane_bytes(1_000_000, 16)
+        narrowed = {
+            p.name: {
+                "dtype": p.dtype,
+                "bytes_per_peer": round(plane_b[p.name] / 1e6, 3),
+                "saved_vs_int32_bytes_per_peer": round(
+                    plane_b[p.name]
+                    * (4 / _np.dtype(p.dtype).itemsize - 1) / 1e6, 3
+                ),
+            }
+            for p in PLANES
+            if p.dtype not in ("bool", "key")
+            and _np.dtype(p.dtype).kind == "i"
+            and _np.dtype(p.dtype).itemsize < 4
+        }
+        out["mem_audit"] = {
+            "state_bytes_per_peer_1m": mem.get("state_bytes_per_peer_1m"),
+            "narrowed_planes": narrowed,
+            "entries_bytes_per_peer": {
+                name: e["bytes_per_peer"]
+                for name, e in (mem.get("entries") or {}).items()
+            },
+            "audit_seconds": rep.get("mem_seconds"),
+            "budget": mem.get("budget_path", "memory_budget.toml"),
+        }
     except Exception as e:  # noqa: BLE001 — record, don't kill the bench
         out["lint_deep_s"] = None
         out["lint"]["deep_error"] = repr(e)[:200]
@@ -1369,6 +1406,8 @@ def main(argv: list[str] | None = None) -> int:
             rec["lint"] = lint_status["lint"]
             if "lint_deep_s" in lint_status:
                 rec["lint_deep_s"] = lint_status["lint_deep_s"]
+            if "mem_audit" in lint_status:
+                rec["mem_audit"] = lint_status["mem_audit"]
             with open(detail_path, "w") as f:
                 json.dump(rec, f, indent=1, sort_keys=True)
                 f.write("\n")
@@ -1726,6 +1765,11 @@ def _compact(out: dict) -> dict:
     compact["configs_ms_per_round"] = {
         k: v.get("ms_per_round") for k, v in out.get("configs", {}).items()
     }
+    mem = out.get("mem_audit")
+    if mem and mem.get("state_bytes_per_peer_1m") is not None:
+        # the ROADMAP's 100M-item metric starts here: declared state
+        # bytes per peer slot at the 1M headline shape (m=16)
+        compact["bytes_per_peer_1m"] = mem["state_bytes_per_peer_1m"]
     ns = out.get("north_star")
     if ns:
         paths = tuple(p for p in ("xla", "pallas", "matching") if p in ns)

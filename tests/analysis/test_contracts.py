@@ -100,8 +100,10 @@ def test_broken_stream_lease_detected(monkeypatch):
 
         st, stats = orig(state, cfg, plan, **kw)
         if kw.get("stream") is not None:
+            # int32 is a WIDENING drift now that the plane's declared
+            # dtype is int16 (core.state.PLANES)
             st = dataclasses.replace(
-                st, slot_lease=st.slot_lease.astype("int16")
+                st, slot_lease=st.slot_lease.astype("int32")
             )
         return st, stats
 

@@ -81,6 +81,20 @@ def test_entry_names_unique():
     assert len(names) == len(set(names))
 
 
+def test_every_entry_declares_n_peers():
+    """Every matrix entry carries an explicit n_peers (the mem tier's
+    bytes/peer denominator) matching its built state's slot count — n
+    used to be implicit in each builder closure, which a scale metric
+    cannot read."""
+    for ep in EPS:
+        assert ep.n_peers > 0, f"{ep.name}: n_peers undeclared"
+        _, st = ep.build()
+        assert st.alive.shape[0] == ep.n_peers, (
+            f"{ep.name}: declared n_peers={ep.n_peers} but the built "
+            f"state has {st.alive.shape[0]} slots"
+        )
+
+
 def test_trace_cache_shared_across_consumers():
     """The same cache dict must make the second consumer reuse the first's
     TracedEntry objects — the CLI's one-matrix-per-invocation guarantee."""

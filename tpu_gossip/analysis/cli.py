@@ -200,6 +200,27 @@ def main(argv: list[str] | None = None) -> int:
         help="run only the deep tier",
     )
     ap.add_argument(
+        "--mem", action="store_true",
+        help="add the graftmem memory tier (plane ledger + live-range "
+        "residency, declared-width audit, static wire cross-check, "
+        "memory_budget.toml gate) — shares the entry-point traces with "
+        "the audit and deep tiers",
+    )
+    ap.add_argument(
+        "--mem-only", action="store_true",
+        help="run only the memory tier",
+    )
+    ap.add_argument(
+        "--budget", default=None,
+        help="memory budget file (default: <repo>/memory_budget.toml)",
+    )
+    ap.add_argument(
+        "--write-budget", action="store_true",
+        help="write the current per-entry residency ledgers to the "
+        "memory budget file and exit 0 (the committed diff is the "
+        "review surface)",
+    )
+    ap.add_argument(
         "--baseline", default=None,
         help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})",
     )
@@ -234,14 +255,35 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     explicit_paths = bool(args.paths)
+    # the memory tier is trace-only (no AST side): explicit-path runs
+    # lint sources without importing the fixtures' runtime, so the
+    # mem-only modes cannot run there — a silent no-op would exit 0
+    # having analyzed NOTHING, which is worse than refusing
+    if (args.write_budget or args.mem_only) and explicit_paths:
+        print(
+            "--mem-only/--write-budget trace the full entry-point matrix; "
+            "they cannot run with explicit paths",
+            file=sys.stderr,
+        )
+        return 2
     run_contracts = (
         (not args.no_contracts and not explicit_paths and only is None)
         or args.contracts_only
-    ) and not args.deep_only
-    run_deep_tier = args.deep or args.deep_only
+    ) and not (args.deep_only or args.mem_only or args.write_budget)
+    run_deep_tier = (
+        (args.deep or args.deep_only)
+        and not (args.mem_only or args.write_budget)
+    )
+    run_mem_tier = (
+        args.mem or args.mem_only or args.write_budget
+    ) and not explicit_paths
     t0 = time.perf_counter()
     findings: list[Finding] = []
-    if not (args.contracts_only or args.deep_only):
+    # --write-budget is a dedicated mode: only the mem trace runs (an AST
+    # lint or contract audit whose findings the early exit would swallow
+    # must not run at all)
+    if not (args.contracts_only or args.deep_only or args.mem_only
+            or args.write_budget):
         try:
             findings = lint_paths(
                 args.paths or list(_DEFAULT_SCOPE), root=root, rules=only
@@ -273,6 +315,34 @@ def main(argv: list[str] | None = None) -> int:
         else:
             _ensure_multi_device_env()
             findings = findings + run_deep(cache=trace_cache)
+    mem_report = None
+    mem_seconds = None
+    if run_mem_tier:
+        _ensure_multi_device_env()
+        from tpu_gossip.analysis.mem import run_mem
+
+        t_mem = time.perf_counter()
+        mem_findings, mem_report = run_mem(
+            cache=trace_cache,
+            budget_path=args.budget,
+            check_budget=not args.write_budget,
+        )
+        mem_seconds = round(time.perf_counter() - t_mem, 2)
+        ledgers = mem_report.pop("ledgers")
+        if args.write_budget:
+            from tpu_gossip.analysis.mem.budget import write_budget
+
+            budget_path = (
+                Path(args.budget) if args.budget
+                else root / "memory_budget.toml"
+            )
+            write_budget(budget_path, ledgers)
+            print(
+                f"wrote {len(ledgers)} entry budget(s) to {budget_path}",
+                file=sys.stderr,
+            )
+            return 0
+        findings = findings + mem_findings
 
     baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
     if args.write_baseline:
@@ -308,6 +378,11 @@ def main(argv: list[str] | None = None) -> int:
                     "rules": sorted(RULES),
                     "contract_audit": run_contracts,
                     "deep": run_deep_tier,
+                    "mem": run_mem_tier,
+                    # entries are name-sorted (run_mem) — the same
+                    # identity-stable-diff property as the findings order
+                    "mem_report": mem_report,
+                    "mem_seconds": mem_seconds,
                     "elapsed_seconds": round(elapsed, 2),
                 },
                 indent=1,
@@ -322,6 +397,7 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(RULES)} rules"
             + (", contract audit on" if run_contracts else "")
             + (", deep tier on" if run_deep_tier else "")
+            + (", mem tier on" if run_mem_tier else "")
             + f", {elapsed:.1f}s"
         )
         print(tail, file=sys.stderr)

@@ -277,24 +277,30 @@ def _check_gossip_round() -> list:
 @audit_check("growth_registry_plane")
 def _check_growth_registry() -> list:
     """The registry plane's DECLARED leaf specs: SwarmState must carry
-    join_round/admitted_by/degree_credit as int32 (N,) rows and init them
-    to the bootstrap-member convention — the fields every growth check,
-    checkpoint loader, and repartition fill assumes."""
+    join_round/admitted_by/degree_credit as (N,) rows of their
+    plane-registry dtypes (core.state.PLANES — join_round is the narrow
+    int16 plane) and init them to the bootstrap-member convention — the
+    fields every growth check, checkpoint loader, and repartition fill
+    assumes."""
     import numpy as np
+
+    from tpu_gossip.core.state import plane_registry
 
     problems: list[str] = []
     ctx = _ctx()
     st, _ = ctx["state_for"](ctx["dg"], 1)
     n = ctx["dg"].n_pad
+    reg = plane_registry()
     for field in ("join_round", "admitted_by", "degree_credit"):
         leaf = getattr(st, field, None)
         if leaf is None:
             problems.append(f"SwarmState lost registry field {field!r}")
             continue
-        if tuple(leaf.shape) != (n,) or str(leaf.dtype) != "int32":
+        want = reg[field].dtype
+        if tuple(leaf.shape) != (n,) or str(leaf.dtype) != want:
             problems.append(
                 f"SwarmState.{field}: {tuple(leaf.shape)}/{leaf.dtype} != "
-                f"declared ({n},)/int32"
+                f"declared ({n},)/{want}"
             )
     if not problems:
         ex = np.asarray(st.exists)

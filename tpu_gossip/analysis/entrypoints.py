@@ -63,6 +63,10 @@ class EntryPoint:
     stats_leading: tuple | None = ()  # None: entry returns no stats
     has_ici: bool = False
     jit_name: str | None = None  # jitted+donating entries: pjit name param
+    # state-slot count of the entry's swarm (== the traced state's leading
+    # dim, test-pinned) — the mem tier's bytes/peer denominator; 0 would
+    # mean a matrix entry whose scale nobody declared, which cannot exist
+    n_peers: int = 0
 
 
 @dataclasses.dataclass
@@ -280,6 +284,7 @@ def _local_entries() -> list[EntryPoint]:
         return EntryPoint(
             name=name, engine=eng, kind="round",
             audit_check="gossip_round_local", build=build,
+            n_peers=graph.n_pad,
         )
 
     for m in _MSG_SLOTS:
@@ -338,6 +343,7 @@ def _local_entries() -> list[EntryPoint]:
         eps.append(EntryPoint(
             name=f"local[{eng},scenario]", engine=eng, kind="round",
             audit_check="gossip_round_local", build=build_scen,
+            n_peers=graph.n_pad,
         ))
     # the GROWING round (growth/): admission slice + Gumbel-top-k +
     # registry scatters must keep the round a state fixed point on every
@@ -357,6 +363,7 @@ def _local_entries() -> list[EntryPoint]:
         eps.append(EntryPoint(
             name=f"local[{eng},growth]", engine=eng, kind="round",
             audit_check="gossip_round_local", build=build_grow,
+            n_peers=graph.n_pad,
         ))
 
     # the LOADED round (traffic/): Poisson injection + lease age-out must
@@ -374,6 +381,7 @@ def _local_entries() -> list[EntryPoint]:
         eps.append(EntryPoint(
             name=f"local[{eng},stream]", engine=eng, kind="round",
             audit_check="gossip_round_local", build=build_stream,
+            n_peers=graph.n_pad,
         ))
 
     # scenario + growth COMPOSED (join_burst phases ride the fault tables;
@@ -394,6 +402,7 @@ def _local_entries() -> list[EntryPoint]:
     eps.append(EntryPoint(
         name="local[xla,scenario+growth]", engine="xla", kind="round",
         audit_check="gossip_round_local", build=build_both,
+        n_peers=ctx["dg"].n_pad,
     ))
 
     # scenario + growth + stream FULLY COMPOSED — "flash crowd joins
@@ -417,6 +426,7 @@ def _local_entries() -> list[EntryPoint]:
     eps.append(EntryPoint(
         name="local[xla,scenario+growth+stream]", engine="xla", kind="round",
         audit_check="gossip_round_local", build=build_all_three,
+        n_peers=ctx["dg"].n_pad,
     ))
 
     # the CONTROLLED round (control/): the feedback stage — masked
@@ -439,6 +449,7 @@ def _local_entries() -> list[EntryPoint]:
         eps.append(EntryPoint(
             name=f"local[{eng},control]", engine=eng, kind="round",
             audit_check="gossip_round_local", build=build_ctl,
+            n_peers=graph.n_pad,
         ))
 
     # scenario + growth + stream + control: the FULL composition — FOUR
@@ -462,6 +473,7 @@ def _local_entries() -> list[EntryPoint]:
     eps.append(EntryPoint(
         name="local[xla,scenario+growth+stream+control]", engine="xla",
         kind="round", audit_check="gossip_round_local", build=build_all_four,
+        n_peers=ctx["dg"].n_pad,
     ))
 
     # the jitted loop entries (donating: state aliases the carry)
@@ -473,6 +485,7 @@ def _local_entries() -> list[EntryPoint]:
         name="local[simulate]", engine="xla", kind="simulate",
         audit_check="simulate_and_coverage", build=build_sim,
         stats_leading=(_SIM_ROUNDS,), jit_name="simulate",
+        n_peers=ctx["dg"].n_pad,
     ))
 
     def build_cov():
@@ -485,6 +498,7 @@ def _local_entries() -> list[EntryPoint]:
         name="local[run_until_coverage]", engine="xla", kind="coverage",
         audit_check="simulate_and_coverage", build=build_cov,
         stats_leading=None, jit_name="run_until_coverage",
+        n_peers=ctx["dg"].n_pad,
     ))
     return eps
 
@@ -542,6 +556,7 @@ def _dist_entries() -> list[EntryPoint]:
             name=name, engine=eng, kind=kind, audit_check=audit_check,
             build=build, stats_leading=stats_leading, has_ici=has_ici,
             jit_name=jit_name,
+            n_peers=plan.n if eng == "dist-matching" else sg.n_pad,
         )
 
     eps.append(dist_ep(

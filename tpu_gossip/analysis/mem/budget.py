@@ -1,0 +1,154 @@
+"""memory_budget.toml — the committed per-entry residency budget.
+
+The CI gate the ledger feeds: every matrix entry's peak live bytes (and
+const residency) is pinned in a committed file; a PR whose trace regresses
+an entry by more than :data:`TOLERANCE` over its budget fails CI with a
+``mem-budget-regression`` finding, and an entry missing from the budget
+(a new matrix cell nobody priced) fails with ``mem-budget-missing``.
+Refresh deliberately with ``python -m tpu_gossip.analysis --mem
+--write-budget`` — the diff of the committed file IS the review surface,
+exactly the lockfile discipline ``lint_baseline.toml`` applies to
+findings. Budget entries naming no current matrix cell are reported in
+the CLI json as ``stale`` but do not fail (dist cells are host-dependent:
+a laptop whose device count cannot mesh 128 must still lint clean).
+
+Same restricted-TOML reader/writer approach as analysis/baseline.py
+(Python 3.10 container: no stdlib tomllib): ``version`` scalar +
+``[[entry]]`` tables with string/int/float values.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tpu_gossip.analysis.registry import Finding
+
+__all__ = [
+    "DEFAULT_BUDGET",
+    "TOLERANCE",
+    "load_budget",
+    "write_budget",
+    "budget_findings",
+]
+
+DEFAULT_BUDGET = "memory_budget.toml"
+TOLERANCE = 0.05  # an entry may grow 5% over budget before failing
+
+REGRESSION_RULE = "mem-budget-regression"
+MISSING_RULE = "mem-budget-missing"
+
+_GATED_FIELDS = ("peak_bytes", "const_bytes")
+
+
+def _parse_value(raw: str):
+    raw = raw.strip()
+    if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in ("'", '"'):
+        return raw[1:-1]
+    try:
+        return int(raw)
+    except ValueError:
+        try:
+            return float(raw)
+        except ValueError:
+            return raw
+
+
+def load_budget(path: str | Path) -> dict:
+    """name -> {peak_bytes, const_bytes, bytes_per_peer, n_peers}; empty
+    when the file is missing (every entry then reports missing — a fresh
+    checkout without a budget cannot silently pass the gate)."""
+    p = Path(path)
+    if not p.is_file():
+        return {}
+    entries: dict = {}
+    cur: dict | None = None
+
+    def flush():
+        if cur and "name" in cur:
+            entries[cur["name"]] = {
+                k: v for k, v in cur.items() if k != "name"
+            }
+
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[entry]]":
+            flush()
+            cur = {}
+        elif "=" in line:
+            key, _, value = line.partition("=")
+            if cur is not None:
+                cur[key.strip()] = _parse_value(value)
+    flush()
+    return entries
+
+
+def write_budget(path: str | Path, ledgers: dict) -> None:
+    """Write the committed budget from name -> EntryLedger."""
+    lines = [
+        "# tpu-gossip memory budget — per-entry peak live bytes of the",
+        "# shared traced entry-point matrix (analysis/mem/ledger.py).",
+        "# CI fails any entry regressing > 5% over its line here, so a",
+        "# widened plane or a new resident intermediate shows up as a",
+        "# DIFF OF THIS FILE, reviewed like a lockfile. Refresh:",
+        "#   python -m tpu_gossip.analysis --mem --write-budget",
+        "version = 1",
+    ]
+    for name in sorted(ledgers):
+        led = ledgers[name]
+        lines += [
+            "",
+            "[[entry]]",
+            f'name = "{name}"',
+            f"n_peers = {led.n_peers}",
+            f"peak_bytes = {led.peak_bytes}",
+            f"const_bytes = {led.const_bytes}",
+            f"bytes_per_peer = {led.bytes_per_peer}",
+        ]
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
+def budget_findings(ledgers: dict, budget: dict) -> tuple[list, list]:
+    """(findings, stale_names) of the current ledgers vs the budget."""
+    findings: list[Finding] = []
+    for name in sorted(ledgers):
+        led = ledgers[name]
+        pinned = budget.get(name)
+        if pinned is None:
+            findings.append(Finding(
+                file=f"<mem:{name}>", line=0, col=0, rule=MISSING_RULE,
+                message=(
+                    f"matrix entry has no line in {DEFAULT_BUDGET} "
+                    f"(peak {led.peak_bytes} B, "
+                    f"{led.bytes_per_peer} B/peer unbudgeted)"
+                ),
+                hint="price the new entry deliberately: python -m "
+                "tpu_gossip.analysis --mem --write-budget, and review "
+                "the budget diff",
+                qualname=name,
+            ))
+            continue
+        for field in _GATED_FIELDS:
+            allowed = pinned.get(field)
+            got = getattr(led, field)
+            if not isinstance(allowed, (int, float)):
+                continue
+            if got > allowed * (1.0 + TOLERANCE):
+                findings.append(Finding(
+                    file=f"<mem:{name}>", line=0, col=0,
+                    rule=REGRESSION_RULE,
+                    message=(
+                        f"{field} {got} B exceeds the budget "
+                        f"{int(allowed)} B by "
+                        f"{got / max(allowed, 1) - 1:.1%} "
+                        f"(> {TOLERANCE:.0%} tolerance; top residents: "
+                        f"{led.top[:3]})"
+                    ),
+                    hint="shrink the regression, or — if the growth is "
+                    "deliberate — refresh with --write-budget and let "
+                    "the budget diff carry the review",
+                    qualname=name,
+                ))
+    stale = sorted(set(budget) - set(ledgers))
+    return findings, stale

@@ -1,0 +1,187 @@
+"""Pass 2 — declared-width audit over the plane registry and the traces.
+
+Every :class:`~tpu_gossip.core.state.SwarmState` plane carries a declared
+minimal materialization dtype in ``core.state.PLANES``. This pass checks:
+
+- **materialized width** (``mem-plane-width``): the traced state's planes
+  must materialize EXACTLY their declared dtype — wider is the silent
+  regression the 100M bytes/peer budget exists to stop (a PR re-widening
+  ``join_round`` to int32 fails CI here, not in a hardware bill months
+  later); narrower-than-declared is the same finding (the registry is
+  the single width truth — narrow the declaration first). The registry
+  must also cover exactly the dataclass fields: a new plane without a
+  declared width cannot land.
+- **widening casts** (``mem-widening-cast``): any ``convert_element_type``
+  that widens an already >= 16-bit integer/float operand of
+  (N,)-or-larger size inside a round body, and ANY promotion to a 64-bit
+  dtype (the silent int32->int64 / f32->f64 class — x64 stays off
+  repo-wide, so a 64-bit eqn in a trace means someone turned it on).
+  Bool->int mask materializations are exempt (they are arithmetic
+  staging, not plane widening — the popcount/billing idiom everywhere).
+  Escape hatch: the usual line pragma with a reason
+  (``# graftlint: disable=mem-widening-cast -- <why>``) at the emitting
+  source line — this pass reads the anchored module's pragma map the way
+  the AST rules do, because a widening cast HAS a source line to carry
+  the justification (unlike the allowlist-only deep passes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from tpu_gossip.analysis.registry import Finding
+
+__all__ = ["width_findings", "plane_width_findings", "widening_cast_findings"]
+
+WIDTH_RULE = "mem-plane-width"
+CAST_RULE = "mem-widening-cast"
+
+_STATE_FILE = "tpu_gossip/core/state.py"
+
+
+def plane_width_findings(traced) -> list:
+    """Materialized plane dtypes vs the declared registry, once per plane."""
+    import numpy as np
+
+    from tpu_gossip.core.state import SwarmState, plane_registry
+
+    reg = plane_registry()
+    fields = {f.name for f in dataclasses.fields(SwarmState)}
+    findings: list[Finding] = []
+    for name in sorted(fields - set(reg)):
+        findings.append(Finding(
+            file=_STATE_FILE, line=0, col=0, rule=WIDTH_RULE,
+            message=f"SwarmState.{name} has no declared width in the "
+            "PLANES registry — an unbudgeted plane cannot land",
+            hint="add a PlaneSpec to core.state.PLANES declaring the "
+            "minimal dtype and the cap that makes it sufficient",
+            qualname=f"SwarmState.{name}",
+        ))
+    for name in sorted(set(reg) - fields):
+        findings.append(Finding(
+            file=_STATE_FILE, line=0, col=0, rule=WIDTH_RULE,
+            message=f"PLANES declares {name!r} but SwarmState has no such "
+            "field — stale registry entry",
+            hint="drop the PlaneSpec (or restore the plane)",
+            qualname=f"SwarmState.{name}",
+        ))
+
+    seen: set = set()
+    for te in traced.values():
+        if te.state is None:
+            continue
+        for f in dataclasses.fields(type(te.state)):
+            spec = reg.get(f.name)
+            if spec is None or spec.dtype == "key" or f.name in seen:
+                continue
+            leaf = getattr(te.state, f.name, None)
+            if leaf is None or not hasattr(leaf, "dtype"):
+                continue
+            got = np.dtype(leaf.dtype) if leaf.dtype.kind != "V" else None
+            if got is None:
+                continue
+            want = np.dtype(spec.dtype)
+            if got != want:
+                seen.add(f.name)
+                direction = "WIDER" if got.itemsize > want.itemsize else \
+                    "narrower"
+                findings.append(Finding(
+                    file=_STATE_FILE, line=0, col=0, rule=WIDTH_RULE,
+                    message=(
+                        f"SwarmState.{f.name} materializes {got} — "
+                        f"{direction} than the declared {want} "
+                        f"({spec.why})"
+                    ),
+                    hint="narrow the materialization to the declared "
+                    "dtype, or widen the PlaneSpec declaration in the "
+                    "same commit with the new cap written down",
+                    qualname=f"SwarmState.{f.name}",
+                ))
+    return findings
+
+
+@functools.lru_cache(maxsize=None)
+def _module_pragmas(rel: str):
+    """Pragma map of one repo source file (walker parse, cached)."""
+    from tpu_gossip.analysis.cli import repo_root
+    from tpu_gossip.analysis.walker import ModuleInfo
+
+    path = repo_root() / rel
+    if not path.is_file():
+        return {}
+    try:
+        return ModuleInfo(path, rel).pragmas
+    except SyntaxError:
+        return {}
+
+
+def _pragma_suppressed(src) -> bool:
+    if src is None:
+        return False
+    prag = _module_pragmas(src.file).get(src.line)
+    return prag is not None and (
+        "*" in prag.rules or CAST_RULE in prag.rules
+    )
+
+
+def widening_cast_findings(traced) -> list:
+    """Widening convert_element_type eqns over the traced matrix."""
+    from tpu_gossip.analysis.deep.jaxpr_tools import iter_eqns, src_of
+
+    findings: list[Finding] = []
+    seen: set = set()
+    for name, te in traced.items():
+        if te.jaxpr is None:
+            continue
+        n = te.ep.n_peers if te.ep is not None else 0
+        for eqn, _ in iter_eqns(te.jaxpr.jaxpr):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            new = eqn.params.get("new_dtype")
+            operand = eqn.invars[0]
+            old = getattr(getattr(operand, "aval", None), "dtype", None)
+            if new is None or old is None:
+                continue
+            import numpy as np
+
+            old, new = np.dtype(old), np.dtype(new)
+            to64 = new.itemsize >= 8 and new.kind in "iuf"
+            widening = (
+                old.kind in "iuf" and new.kind in "iuf"
+                and old.itemsize >= 2
+                and new.itemsize > old.itemsize
+                and operand.aval.size >= max(n, 1)
+            )
+            if not (to64 or widening):
+                continue
+            src = src_of(eqn)
+            if _pragma_suppressed(src):
+                continue
+            qual = src.function if src else name
+            file = src.file if src else f"<mem:{name}>"
+            key = (file, qual, str(old), str(new))
+            if key in seen:
+                continue
+            seen.add(key)
+            what = "64-bit promotion" if to64 else "widening cast"
+            shape = tuple(operand.aval.shape)
+            findings.append(Finding(
+                file=file, line=src.line if src else 0,
+                col=0, rule=CAST_RULE,
+                message=(
+                    f"{what} {old}->{new} on a {shape} operand inside the "
+                    f"round body (first seen tracing {name})"
+                ),
+                hint="keep (N,)-scale arithmetic at the plane's declared "
+                "width, or carry a line pragma with the reason: "
+                "# graftlint: disable=mem-widening-cast -- <why>",
+                qualname=qual,
+            ))
+    return findings
+
+
+def width_findings(traced) -> list:
+    out = plane_width_findings(traced)
+    out.extend(widening_cast_findings(traced))
+    return out

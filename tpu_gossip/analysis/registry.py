@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, Iterable
 
-__all__ = ["Finding", "RULES", "DEEP_RULES", "rule", "run_rules"]
+__all__ = ["Finding", "RULES", "DEEP_RULES", "MEM_RULES", "rule", "run_rules"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +91,21 @@ DEEP_RULES = frozenset({
     "deep-trace-error",
 })
 
+# rule ids owned by the jaxpr memory tier (analysis/mem/) — like the deep
+# tier, trace-level passes outside RULES; pragmas may name the one rule
+# with a source anchor (mem-widening-cast honors line pragmas the way the
+# AST rules do), and the unknown-rule check must not cry wolf on any
+MEM_RULES = frozenset({
+    "mem-plane-width",
+    "mem-widening-cast",
+    "mem-donation-residency",
+    "mem-hot-clone",
+    "mem-wire-drift",
+    "mem-budget-regression",
+    "mem-budget-missing",
+    "mem-trace-error",
+})
+
 
 def rule(rule_id: str):
     """Register a rule under ``rule_id`` (decorator)."""
@@ -141,7 +156,8 @@ def run_rules(module, only: Iterable[str] | None = None) -> list[Finding]:
                 )
             )
         unknown = (
-            prag.rules - set(RULES) - DEEP_RULES - {"*", "pragma-needs-reason"}
+            prag.rules - set(RULES) - DEEP_RULES - MEM_RULES
+            - {"*", "pragma-needs-reason"}
         )
         if unknown:
             findings.append(

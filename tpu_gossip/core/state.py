@@ -41,6 +41,12 @@ from tpu_gossip.core.topology import Graph
 __all__ = [
     "SwarmConfig",
     "SwarmState",
+    "PlaneSpec",
+    "PLANES",
+    "ROUND_CAP",
+    "plane_registry",
+    "state_plane_bytes",
+    "state_bytes_per_peer",
     "init_swarm",
     "clone_state",
     "message_slot",
@@ -48,6 +54,126 @@ __all__ = [
     "save_swarm",
     "load_swarm",
 ]
+
+# declared value cap for every ROUND-NUMBER-valued plane (join_round,
+# slot_lease — and the int16 candidates last_hb/infected_round when they
+# narrow): the widest round index the narrow planes can hold. No tracked
+# run approaches it (the 10M north star converges in tens of rounds; the
+# longest streaming horizons are hundreds) — a campaign that needs more
+# rounds than this widens the declared dtype in PLANES *first*, which is
+# exactly the review the mem tier's width audit forces.
+ROUND_CAP = 2**15 - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PlaneSpec:
+    """Declared memory contract of one :class:`SwarmState` plane.
+
+    ``dtype`` is the MINIMAL materialization the plane needs at the
+    declared caps — the mem tier (analysis/mem/widths.py) fails CI when
+    the state materializes a plane wider than this, so widening a plane
+    is a reviewed registry edit, never a silent dtype drift.
+    ``shape`` is symbolic in N (peer slots), M (msg slots), S (rewire
+    slots), D (edge slots): the terms :func:`state_plane_bytes` prices —
+    the ROADMAP's bytes/peer metric is computed from this table, not
+    measured arrays, so it is quotable at 100M without building anything.
+    ``info_bits`` is the information content per element (the bit-packing
+    headroom the 100M item tracks: a bool plane materializes 8 bits for
+    1, SIR/liveness fit 2 bits jointly, …).
+    """
+
+    name: str
+    dtype: str  # declared minimal materialization (numpy dtype name)
+    shape: str  # symbolic: "(N,)" | "(N, M)" | "(N+1,)" | "(D,)" | "(N, S)" | "(M,)" | "()"
+    info_bits: int  # minimal information content per element
+    why: str  # the cap that makes the width sufficient
+
+
+PLANES: tuple[PlaneSpec, ...] = (
+    PlaneSpec("row_ptr", "int32", "(N+1,)", 32,
+              "cumulative edge counts: D < 2^31 at every tracked scale"),
+    PlaneSpec("col_idx", "int32", "(D,)", 32,
+              "peer row ids: N up to 100M needs 27 bits"),
+    PlaneSpec("seen", "bool", "(N, M)", 1, "dedup bit"),
+    PlaneSpec("forwarded", "bool", "(N, M)", 1, "relay bit"),
+    PlaneSpec("infected_round", "int32", "(N, M)", 16,
+              "round numbers (<= ROUND_CAP) — int16 is the next narrow; "
+              "kept int32 until its bit-identity matrix is re-pinned"),
+    PlaneSpec("recovered", "bool", "(N, M)", 1,
+              "SIR removed bit (with seen: the 2-bit SIR state)"),
+    PlaneSpec("exists", "bool", "(N,)", 1, "membership bit"),
+    PlaneSpec("alive", "bool", "(N,)", 1, "liveness bit"),
+    PlaneSpec("silent", "bool", "(N,)", 1, "fault bit"),
+    PlaneSpec("last_hb", "int32", "(N,)", 16,
+              "round numbers (<= ROUND_CAP) — int16 candidate; kept int32 "
+              "until its matrix is re-pinned"),
+    PlaneSpec("declared_dead", "bool", "(N,)", 1, "detector verdict bit"),
+    PlaneSpec("rewired", "bool", "(N,)", 1, "re-attach bit"),
+    PlaneSpec("rewire_targets", "int32", "(N, S)", 32,
+              "peer row ids: need 27 bits at 100M"),
+    PlaneSpec("fault_held", "bool", "(N, M)", 1, "delay-buffer bit"),
+    PlaneSpec("join_round", "int16", "(N,)", 16,
+              "round numbers: -1 or a round index <= ROUND_CAP"),
+    PlaneSpec("admitted_by", "int32", "(N,)", 32,
+              "peer row ids: need 27 bits at 100M"),
+    PlaneSpec("degree_credit", "int32", "(N,)", 32,
+              "unfolded in-edge counts: a hub can hold > 2^15 credits "
+              "between rematerializations at 100M"),
+    PlaneSpec("slot_lease", "int16", "(M,)", 16,
+              "round numbers: -1 or a round index <= ROUND_CAP"),
+    PlaneSpec("control_lvl", "int32", "()", 8,
+              "level index into a tiny fanout table; scalar — narrowing "
+              "saves nothing"),
+    PlaneSpec("pipe_buf", "bool", "(N, M)", 1, "in-flight delivery bit"),
+    PlaneSpec("rng", "key", "()", 64, "threefry key (2x uint32)"),
+    PlaneSpec("round", "int32", "()", 16, "scalar round cursor"),
+)
+
+
+def plane_registry() -> dict:
+    """name -> :class:`PlaneSpec`, the mem tier's lookup view."""
+    return {p.name: p for p in PLANES}
+
+
+def _dtype_bytes(dtype: str) -> int:
+    return 8 if dtype == "key" else np.dtype(dtype).itemsize
+
+
+def state_plane_bytes(
+    n: int, m: int, rewire_slots: int = 1, d: int | None = None
+) -> dict:
+    """Declared bytes per plane at (N=n, M=m, S=rewire_slots, D=d).
+
+    ``d`` (edge slots) defaults to 0 — topology residency depends on the
+    generator, so callers quoting a full swarm pass their edge count;
+    the per-peer STATE metric the ROADMAP tracks excludes it either way.
+    """
+    d = 0 if d is None else d
+    dims = {"N": n, "M": m, "S": max(rewire_slots, 1), "D": d}
+    out = {}
+    for p in PLANES:
+        elems = 1
+        for term in p.shape.strip("()").split(","):
+            term = term.strip()
+            if not term:
+                continue
+            if term == "N+1":
+                elems *= n + 1
+            else:
+                elems *= dims[term]
+        out[p.name] = elems * _dtype_bytes(p.dtype)
+    return out
+
+
+def state_bytes_per_peer(
+    n: int, m: int, rewire_slots: int = 1, d: int | None = None
+) -> float:
+    """The ROADMAP's tracked metric: declared state bytes per peer slot.
+
+    Pure registry arithmetic — no arrays are built, so it is quotable at
+    any n (bench.py records it at 1M; the 100M item budgets against it).
+    """
+    return sum(state_plane_bytes(n, m, rewire_slots, d).values()) / n
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,7 +274,7 @@ class SwarmState:
     # credit when it folds the fresh edges into the CSR. Checkpoints that
     # predate the plane load with it zeroed (join_round 0 on existing
     # rows, -1 elsewhere) and capacity == n.
-    join_round: jax.Array  # int32 (N,) — round the slot joined (-1: never)
+    join_round: jax.Array  # int16 (N,) — round the slot joined (-1: never; rounds <= ROUND_CAP per the PLANES registry)
     admitted_by: jax.Array  # int32 (N,) — admitting-seed row id (-1: bootstrap member)
     degree_credit: jax.Array  # int32 (N,) — unfolded fresh in-edges (+1 each)
     # streaming serving plane (traffic/): the slot-lease table that turns
@@ -164,7 +290,7 @@ class SwarmState:
     # fixed single-epidemic run never pays for it); checkpoints that
     # predate the field load with every slot free except those
     # ``init_swarm`` seeded (docs/streaming_plane.md).
-    slot_lease: jax.Array  # int32 (M,)
+    slot_lease: jax.Array  # int16 (M,) — lease round (rounds <= ROUND_CAP per the PLANES registry)
     # adaptive-control cursor (control/): the level index into the
     # compiled policy's bounded fanout table — -1 = uninitialized (the
     # first controlled round starts at the widest level). Like
@@ -295,6 +421,21 @@ def load_swarm(path) -> SwarmState:
         kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
         kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
         kwargs["pipe_buf"] = jnp.zeros((n, m), dtype=bool)
+    # declared-width cast: checkpoints written before a plane narrowed
+    # (PLANES registry — join_round/slot_lease int32 -> int16) carry the
+    # old wider dtype; values are bounded by the declared caps (ROUND_CAP
+    # for the round-valued planes), so the cast is lossless, and without
+    # it a restored state would break the round map's dtype fixed point
+    # (contract audit) the first time it rode a scan carry
+    reg = plane_registry()
+    for name in list(kwargs):
+        spec = reg.get(name)
+        if spec is None or spec.dtype == "key":
+            continue
+        want = np.dtype(spec.dtype)
+        leaf = kwargs[name]
+        if leaf.dtype != want and leaf.dtype.kind == want.kind:
+            kwargs[name] = leaf.astype(want)
     return SwarmState(**kwargs)
 
 
@@ -305,7 +446,7 @@ def _implied_leases(seen: jax.Array) -> jax.Array:
     empty slots are free. Streams attached on resume see the old epidemics
     as aged round-0 leases, so a TTL shorter than the checkpoint's round
     recycles them promptly instead of conflating new traffic into them."""
-    return jnp.where(jnp.any(seen, axis=0), 0, -1).astype(jnp.int32)
+    return jnp.where(jnp.any(seen, axis=0), 0, -1).astype(jnp.int16)
 
 
 def _zero_registry(exists: jax.Array) -> dict:
@@ -313,7 +454,7 @@ def _zero_registry(exists: jax.Array) -> dict:
     row is a bootstrap member (join_round 0, no admitting seed), no growth
     edges outstanding."""
     return {
-        "join_round": jnp.where(exists, 0, -1).astype(jnp.int32),
+        "join_round": jnp.where(exists, 0, -1).astype(jnp.int16),
         "admitted_by": jnp.full(exists.shape, -1, dtype=jnp.int32),
         "degree_credit": jnp.zeros(exists.shape, dtype=jnp.int32),
     }
@@ -415,7 +556,7 @@ def init_swarm(
     n, m = config.n_peers, config.msg_slots
     seen = jnp.zeros((n, m), dtype=bool)
     infected_round = jnp.full((n, m), -1, dtype=jnp.int32)
-    slot_lease = jnp.full((m,), -1, dtype=jnp.int32)
+    slot_lease = jnp.full((m,), -1, dtype=jnp.int16)
     if origins is not None:
         origins = jnp.asarray(origins)
         if origin_slots is not None:
@@ -473,7 +614,7 @@ def init_swarm(
         fault_held=jnp.zeros((n, m), dtype=bool),
         # registry plane: existing rows are bootstrap members (join round
         # 0, no admitting seed); non-existent rows are admittable capacity
-        join_round=jnp.where(exists, 0, -1).astype(jnp.int32),
+        join_round=jnp.where(exists, 0, -1).astype(jnp.int16),
         admitted_by=jnp.full((n,), -1, dtype=jnp.int32),
         degree_credit=jnp.zeros((n,), dtype=jnp.int32),
         slot_lease=slot_lease,

@@ -66,9 +66,37 @@ from tpu_gossip.kernels.permute import apply_pipeline
 __all__ = [
     "shard_matching_plan",
     "gossip_round_dist_matching",
+    "dense_wire_words",
 ]
 
 AXIS = "peers"
+
+
+def dense_wire_words(
+    plan: MatchingPlan, m: int, mode: str, forward_once: bool = False
+) -> int:
+    """THE wire declaration of the matching engine: global dense all_to_all
+    payload words one fault-free round of :func:`_matching_exchange_dist`
+    / :func:`_matching_flood_dist` ships.
+
+    Per word group the pipeline moves one (R, 128) plane through its
+    transpose stages; the pull direction reuses the pushed plane unless
+    ``forward_once`` ships a distinct answer bitmap (mirroring
+    ``_matching_exchange_dist``). Shares its per-stage formula
+    (:func:`~tpu_gossip.dist.transport.matching_dense_stage_words`) with
+    the traced ICI counter; the mem tier's static wire audit recomputes
+    the same figure from the traced all_to_all operand shapes, so the
+    declaration cannot drift from the collectives the round issues.
+    """
+    from tpu_gossip.dist.transport import matching_dense_stage_words
+    from tpu_gossip.kernels.pallas_segment import _slot_groups
+
+    n_stages = sum(1 for st in plan.stages if st[0] in ("t", "tinv"))
+    groups = len(_slot_groups(m))
+    if mode not in ("push", "push_pull", "flood"):
+        raise ValueError(f"unknown mode {mode!r}")
+    apps = 2 if (mode == "push_pull" and forward_once) else 1
+    return apps * groups * n_stages * matching_dense_stage_words(plan.rows)
 
 
 def shard_matching_plan(plan: MatchingPlan, mesh: Mesh) -> MatchingPlan:

@@ -64,6 +64,7 @@ __all__ = [
     "gossip_round_dist",
     "simulate_dist",
     "run_until_coverage_dist",
+    "dense_wire_words",
 ]
 
 AXIS = "peers"
@@ -500,6 +501,37 @@ def shard_swarm(state: SwarmState, mesh: Mesh) -> SwarmState:
         return jax.device_put(x, peer if is_peer_dim else repl)
 
     return jax.tree.map(place, state)
+
+
+def dense_wire_words(
+    sg: "ShardedGraph", m: int, mode: str, forward_once: bool = False
+) -> int:
+    """THE wire declaration of the bucketed engine: global dense all_to_all
+    payload words one fault-free round of :func:`_disseminate_bucketed`
+    ships (headers and sparse lanes excluded — the dense lane is the
+    figure the compact transport is measured against).
+
+    Shares its per-exchange formula
+    (:func:`~tpu_gossip.dist.transport.bucketed_dense_exchange_words`)
+    with the traced ICI counter, and the mem tier's static wire audit
+    (analysis/mem/wire.py) recomputes the same figure from the traced
+    all_to_all operand shapes — so this declaration can neither drift
+    from the counter nor from the collectives the round actually issues.
+    """
+    from tpu_gossip.dist.transport import bucketed_dense_exchange_words
+    from tpu_gossip.kernels.pallas_segment import _slot_groups
+
+    s, b = sg.n_shards, sg.bucket
+    g = len(_slot_groups(m))
+    if mode in ("push", "flood"):
+        return bucketed_dense_exchange_words(s, b, g)
+    if mode != "push_pull":
+        raise ValueError(f"unknown mode {mode!r}")
+    if not forward_once:
+        # merged path: one exchange, G payload words + 1 billing word
+        return bucketed_dense_exchange_words(s, b, g + 1)
+    # split path: a push exchange and a pull (answer) exchange
+    return 2 * bucketed_dense_exchange_words(s, b, g)
 
 
 def _exchange(

@@ -64,6 +64,8 @@ __all__ = [
     "accumulate_ici",
     "zero_ici_totals",
     "build_transport",
+    "bucketed_dense_exchange_words",
+    "matching_dense_stage_words",
     "occupancy_counts",
     "header_spec",
     "compact_index",
@@ -638,6 +640,29 @@ def _build_matching_transport(
 
 
 # ------------------------------------------------------- analytic counter
+# The dense-lane word formulas live in these two STATIC helpers — shared
+# between the traced per-round counters (ici_round_bucketed /
+# ici_round_matching) and each engine's host-side wire declaration
+# (dist/mesh.dense_wire_words, dist/matching_mesh.dense_wire_words). The
+# mem tier's static wire audit (analysis/mem/wire.py) independently
+# recomputes the same figures from the traced all_to_all operand shapes,
+# so a hand-edit here that drifts from what the engines actually ship —
+# or an engine change that silently grows the wire — fails CI.
+def bucketed_dense_exchange_words(s: int, b: int, gp: int) -> int:
+    """Global dense words of ONE bucketed exchange: each of ``s`` shards
+    ships its (S, B, gp) payload (``gp`` int32 words per bucket entry —
+    the packed word groups, +1 billing word on the merged push_pull
+    path)."""
+    return s * s * b * gp
+
+
+def matching_dense_stage_words(rows: int) -> int:
+    """Global dense words of ONE matching transpose stage: every shard
+    ships its (per, 128) int32 block — together the full (R, 128)
+    plane."""
+    return rows * 128
+
+
 def ici_round_bucketed(
     sg, transport: "Transport | None", n_words: int, tx_any: jax.Array,
     ans_any: jax.Array | None, merged: bool,
@@ -657,7 +682,7 @@ def ici_round_bucketed(
     def one(plane_any, gp):
         occ = sg.send_valid & plane_any[srcg]
         counts = jnp.sum(occ, axis=-1, dtype=jnp.int32)  # (S, S)
-        dense = jnp.int32(s * s * b * gp)
+        dense = jnp.int32(bucketed_dense_exchange_words(s, b, gp))
         occupied = jnp.sum(counts) * gp
         if transport is None or not transport.active:
             return IciRound(dense, dense, occupied, jnp.int32(0), jnp.int32(0))
@@ -710,7 +735,7 @@ def ici_round_matching(
         leaf = transport.leaf_slots.astype(jnp.int32)
     else:
         n_stages = sum(1 for st in plan.stages if st[0] in ("t", "tinv"))
-    dense_stage = jnp.int32(r * 128)
+    dense_stage = jnp.int32(matching_dense_stage_words(r))
 
     def one(plane):
         total = zero_ici()

@@ -42,6 +42,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from tpu_gossip.core.state import ROUND_CAP
 from tpu_gossip.core.streams import TRAFFIC_STREAM_SALT
 
 __all__ = [
@@ -185,8 +186,14 @@ def apply_stream(
             conf = ok_i & all_leased
         landed = ok_i & ~suppressed
         # free slots among the draws take the lease; live leases keep
-        # their (older, hence smaller) injection round under max
-        contrib = jnp.where(landed & ~leased, rnd, -1).astype(lease.dtype)
+        # their (older, hence smaller) injection round under max. The
+        # lease plane is the narrow int16 registry width (core.state.
+        # PLANES): the round cursor SATURATES at ROUND_CAP so a campaign
+        # past the cap ages leases out early instead of wrapping into
+        # the free-slot -1 sentinel and losing the lease entirely
+        contrib = jnp.where(
+            landed & ~leased, jnp.minimum(rnd, ROUND_CAP), -1
+        ).astype(lease.dtype)
         lease = lease.at[sl].max(contrib)
         return lease, (landed, conf)
 
