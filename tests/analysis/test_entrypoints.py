@@ -49,6 +49,8 @@ def test_matrix_structural_coverage():
     assert "local[matching,stream]" in names and "local[pallas,stream]" in names
     assert "local[matching,control]" in names and "local[pallas,control]" in names
     assert "local[simulate]" in names and "local[run_until_coverage]" in names
+    # the batched fleet entry (fleet/): composed campaign at batch rank
+    assert "fleet[simulate,composed]" in names
     # dist half (present on this 8-device test host)
     assert {"dist-matching", "dist-bucketed"} <= engines
     for n in (
@@ -85,13 +87,18 @@ def test_every_entry_declares_n_peers():
     """Every matrix entry carries an explicit n_peers (the mem tier's
     bytes/peer denominator) matching its built state's slot count — n
     used to be implicit in each builder closure, which a scale metric
-    cannot read."""
+    cannot read. Priced at BATCH RANK: a fleet entry's alive plane is
+    (K, N), and its denominator is the AGGREGATE K*N slot count (the
+    plane-registry pricing convention, core.state.state_bytes_per_peer)."""
+    import numpy as np
+
     for ep in EPS:
         assert ep.n_peers > 0, f"{ep.name}: n_peers undeclared"
         _, st = ep.build()
-        assert st.alive.shape[0] == ep.n_peers, (
+        slots = int(np.prod(st.alive.shape))
+        assert slots == ep.n_peers, (
             f"{ep.name}: declared n_peers={ep.n_peers} but the built "
-            f"state has {st.alive.shape[0]} slots"
+            f"state has {slots} slots"
         )
 
 

@@ -1,0 +1,376 @@
+"""Fleet engine contracts: batched lanes vs solo runs, campaign parsing.
+
+The conformance contract (docs/fleet_campaigns.md): lane k of a batched
+campaign is BIT-IDENTICAL — full state plus every integer stat — to a
+solo ``simulate`` over exactly the plans the campaign compiled for that
+lane. Pinned here at sampled lanes of a 16-lane campaign whose lanes
+compose scenario × stream × control (the maximal plan surface), plus
+the campaign compiler's parse-time rejections (exit 2 through the CLI).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_gossip import fleet
+from tpu_gossip.core.state import lane_state
+
+
+def _composed_campaign(tmp_path, seeds=16):
+    """A 16-lane campaign composing scenario × stream × control, with a
+    loss sweep and a controller-bound sweep split over two families."""
+    scen = tmp_path / "chaos.toml"
+    scen.write_text(
+        "[scenario]\nname = \"test-chaos\"\n"
+        "[[phase]]\nname = \"lossy\"\nstart = 0\nend = 6\n"
+        "loss = 0.2\ndelay = 0.15\n"
+        "[[phase]]\nname = \"split\"\nstart = 6\nend = 10\n"
+        "partition = \"half\"\n"
+        "[[phase]]\nname = \"storm\"\nstart = 10\nend = 14\n"
+        "churn_leave = 0.05\nchurn_join = 0.2\n"
+        "blackout = {frac = 0.1, seed = 1}\n"
+    )
+    spec = fleet.campaign_from_dict({
+        "name": "composed", "seed": 3,
+        "base": {
+            "peers": 96, "rounds": 18, "slots": 8, "fanout": 2,
+            "mode": "push_pull", "coverage_target": 0.9,
+            "target_ratio": 0.8, "stream_rate": 1.0, "slot_ttl": 12,
+            "control": 0.9, "control_hi": 5, "rewire_slots": 5,
+            "churn_join": 0.02, "refresh_every": 4,
+        },
+        "families": [
+            {"name": "loss-sweep", "scenario": str(scen),
+             "seeds": seeds // 2,
+             "sweeps": [{"axis": "phase.loss", "dist": "uniform",
+                         "lo": 0.05, "hi": 0.5}]},
+            {"name": "bound-sweep", "scenario": str(scen),
+             "seeds": seeds - seeds // 2,
+             "sweeps": [{"axis": "control.hi", "dist": "linspace",
+                         "lo": 2, "hi": 5},
+                        {"axis": "stream.rate", "dist": "uniform",
+                         "lo": 0.5, "hi": 2.0}]},
+        ],
+    })
+    return fleet.compile_campaign(spec)
+
+
+@pytest.fixture(scope="module")
+def composed(tmp_path_factory):
+    camp = _composed_campaign(tmp_path_factory.mktemp("fleet"))
+    fin, stats = fleet.run_campaign(camp, keep_states=True)
+    return camp, fin, stats
+
+
+def _assert_lane_bit_identical(camp, fin, stats, k):
+    solo_fin, solo_stats = fleet.run_lane_solo(camp, k)
+    for f in dataclasses.fields(solo_fin):
+        a = getattr(solo_fin, f.name)
+        b = getattr(fin, f.name)[k]
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"lane {k}: state leaf {f.name} diverges from solo",
+        )
+    for name in solo_stats._fields:
+        a = np.asarray(getattr(solo_stats, name))
+        if a.dtype.kind not in "biu":
+            continue  # float tracks excluded, as in the dist matrix
+        b = np.asarray(getattr(stats, name))[k]
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"lane {k}: integer stat {name} diverges",
+        )
+
+
+@pytest.mark.parametrize("k", [0, 7, 13])
+def test_lane_bit_identical_to_solo(composed, k):
+    """3 sampled lanes of the 16-lane composed campaign — incl. lanes of
+    both families (loss sweep / bound×rate sweep) — reproduce their solo
+    run bit for bit: full state + whole integer stat trajectory."""
+    camp, fin, stats = composed
+    assert camp.k == 16
+    _assert_lane_bit_identical(camp, fin, stats, k)
+
+
+def test_lane_digests_match_solo(composed):
+    """The digest pair the fleet-smoke CI job compares across processes
+    equals the in-process comparison."""
+    camp, fin, stats = composed
+    k = 5
+    solo_fin, solo_stats = fleet.run_lane_solo(camp, k)
+    assert fleet.state_digest(lane_state(fin, k)) == fleet.state_digest(
+        solo_fin
+    )
+    assert fleet.stats_digest(stats, k) == fleet.stats_digest(solo_stats)
+
+
+def test_unified_scenario_value_identical_to_family_compile():
+    """Flag unification is VALUE-transparent: a lane whose family never
+    partitions/blacks-out runs that machinery over zero tables under the
+    unified batch structure, and its STATE trajectory equals a solo run
+    over the family's own (unpadded, flag-minimal) compile."""
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+    from tpu_gossip.sim.engine import simulate
+
+    spec = fleet.campaign_from_dict({
+        "name": "mix", "seed": 0,
+        "base": {"peers": 64, "rounds": 30, "slots": 4, "fanout": 2,
+                 "mode": "push"},
+        "families": [
+            # loss-only family: no partition/blackout/churn of its own
+            {"name": "lossy", "scenario": "scenarios/lossy_links.toml",
+             "seeds": 2},
+            # partition family: forces has_partition on the whole batch
+            {"name": "split", "scenario": "scenarios/split_brain.toml",
+             "seeds": 2},
+        ],
+    }, root="scenarios/campaigns")
+    camp = fleet.compile_campaign(spec)
+    assert camp.scenario.has_partition and camp.scenario.has_loss_delay
+    fin, _ = fleet.run_campaign(camp, keep_states=True)
+
+    # lane 0 (lossy family) vs a solo run over the FAMILY's own compile
+    # — flags off for the classes it never declares
+    own = compile_scenario(
+        scenario_from_dict(
+            fleet.plan._scenario_dict("scenarios/lossy_links.toml", None)
+        ),
+        n_peers=64, n_slots=64, total_rounds=30,
+    )
+    assert not own.has_partition
+    st0, _, _, _, _ = camp.lane(0)
+    solo_fin, _ = simulate(
+        st0, camp.cfg, camp.rounds, None, "fused", own,
+    )
+    for f in ("seen", "infected_round", "alive", "declared_dead", "round"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(solo_fin, f)),
+            np.asarray(getattr(fin, f)[0]),
+            err_msg=f"unified-flag lane diverges from family compile: {f}",
+        )
+
+
+def test_report_has_quantiles_bins_and_frontier(composed):
+    camp, _, stats = composed
+    rep = fleet.campaign_report(camp, stats)
+    fam = {f["family"]: f for f in rep["families"]}
+    rel = fam["loss-sweep"]["reliability"]
+    assert set(rel["quantiles"]) == {"p05", "p25", "p50", "p75", "p95"}
+    lo, hi = rel["bootstrap_ci95_mean"]
+    assert 0.0 <= lo <= hi <= 1.0
+    bins = fam["loss-sweep"]["sweeps"][0]["bins"]
+    assert bins and all("bootstrap_ci95_mean" in b for b in bins)
+    assert sum(b["lanes"] for b in bins) == fam["loss-sweep"]["lanes_judged"]
+    fr = fam["bound-sweep"]["frontier"]
+    assert fr["axis"] == "control.hi"
+    assert {t["value"] for t in fr["per_value"]} == {2.0, 3.0, 4.0, 5.0}
+
+
+def test_clamped_control_bounds_saturate(composed):
+    """A lane's clamped fanout table never exceeds its sampled bound and
+    the batch shares ONE static table width."""
+    camp, _, _ = composed
+    tbl = np.asarray(camp.control.fanout_table)
+    assert tbl.shape[0] == camp.k  # stacked
+    for lane in camp.lanes:
+        if "control.hi" in lane.sampled:
+            assert tbl[lane.index].max() <= int(lane.sampled["control.hi"])
+        assert tbl[lane.index].min() >= 1
+
+
+def test_frontier_nonmonotone_top_break_no_crash():
+    """A noisy sweep whose HIGHEST bound value breaks while lower values
+    hold must report its one-sided truth (first_hold None), not crash
+    (regression: min() of an empty generator)."""
+    from tpu_gossip.fleet.metrics import _frontier
+
+    fr = _frontier(
+        "control.hi",
+        [2, 2, 3, 3, 4, 4],
+        [0.95, 0.93, 0.92, 0.91, 0.80, 0.85],
+        0.9,
+    )
+    assert fr["found"] and fr["last_break"] == 4.0
+    assert fr["first_hold"] is None
+
+
+def test_control_bound_samples_are_integral():
+    """control.lo/hi samples round AT SAMPLING time for every dist, so
+    the value the frontier groups by IS the bound the lane ran with."""
+    rng = np.random.default_rng(0)
+    ax = fleet.SweepAxis(axis="control.hi", dist="uniform", lo=2, hi=5)
+    v = ax.sample(16, rng)
+    np.testing.assert_array_equal(v, np.rint(v))
+
+
+def test_consumed_campaign_refuses_lane_extraction(tmp_path):
+    camp = _composed_campaign(tmp_path, seeds=4)
+    fleet.run_campaign(camp, keep_states=False)
+    with pytest.raises(fleet.CampaignError, match="donated"):
+        camp.lane(0)
+
+
+# ------------------------------------------------------ parse rejections
+def test_reject_single_lane_campaign():
+    with pytest.raises(fleet.CampaignError, match="solo run"):
+        fleet.campaign_from_dict({
+            "name": "one", "base": {"peers": 16, "rounds": 4},
+            "families": [{"name": "f", "seeds": 1}],
+        })
+
+
+def test_reject_duplicate_family_names():
+    """Lanes, scenarios, and report blocks group by family name — a
+    duplicated name would silently cross-wire them."""
+    with pytest.raises(fleet.CampaignError, match="duplicate family"):
+        fleet.campaign_from_dict({
+            "name": "dup", "base": {"peers": 16, "rounds": 4},
+            "families": [
+                {"name": "f", "seeds": 2},
+                {"name": "f", "seeds": 2},
+            ],
+        })
+
+
+def test_reject_out_of_range_phase_probability():
+    """A phase.* axis sampling outside [0, 1] would run clamped values
+    while the report groups lanes by the raw sample — rejected at parse
+    time instead of misreporting what ran."""
+    with pytest.raises(fleet.CampaignError, match="probability"):
+        fleet.campaign_from_dict({
+            "name": "bad", "base": {"peers": 16, "rounds": 4},
+            "families": [{
+                "name": "f", "seeds": 4,
+                "sweeps": [{"axis": "phase.loss", "dist": "uniform",
+                            "lo": 0.5, "hi": 1.5}],
+            }],
+        })
+
+
+def test_reject_unknown_sampled_axis():
+    with pytest.raises(fleet.CampaignError, match="unknown sampled axis"):
+        fleet.campaign_from_dict({
+            "name": "bad", "base": {"peers": 16, "rounds": 4},
+            "families": [{
+                "name": "f", "seeds": 4,
+                "sweeps": [{"axis": "slots", "dist": "uniform",
+                            "lo": 4, "hi": 64}],
+            }],
+        })
+
+
+def test_reject_mixed_static_shapes():
+    """The shared-static-shape backstop: lanes whose compiled plans
+    disagree on structure or leaf shapes can never reach vmap."""
+    import jax.numpy as jnp
+
+    a = {"x": jnp.zeros((4,)), "y": jnp.zeros((2,))}
+    b_shape = {"x": jnp.zeros((5,)), "y": jnp.zeros((2,))}
+    with pytest.raises(fleet.CampaignError, match="static shape"):
+        fleet.plan._check_lane_structures([a, b_shape], "probe")
+    b_struct = {"x": jnp.zeros((4,))}
+    with pytest.raises(fleet.CampaignError, match="structure"):
+        fleet.plan._check_lane_structures([a, b_struct], "probe")
+
+
+def test_reject_join_burst_without_grow(tmp_path):
+    """join_burst phases need a growing fleet — capacity is a static
+    shape the whole batch shares, so one lane cannot grow alone."""
+    spec = fleet.campaign_from_dict({
+        "name": "jb", "seed": 0,
+        "base": {"peers": 96, "rounds": 20, "slots": 4, "fanout": 2},
+        "families": [
+            {"name": "flash",
+             "scenario": "scenarios/flash_crowd_under_fire.toml",
+             "seeds": 2}],
+    }, root="scenarios/campaigns")
+    with pytest.raises(fleet.CampaignError, match="static shape"):
+        fleet.compile_campaign(spec)
+
+
+def test_reject_sweep_matching_no_phase(tmp_path):
+    """A phase-parameter axis that matches no declaring phase would flip
+    a static has_* flag mid-batch — rejected by name."""
+    scen = tmp_path / "noloss.toml"
+    scen.write_text(
+        "[scenario]\nname = \"noloss\"\n"
+        "[[phase]]\nname = \"p\"\nstart = 0\nend = 4\nchurn_leave = 0.1\n"
+    )
+    spec = fleet.campaign_from_dict({
+        "name": "miss", "seed": 0,
+        "base": {"peers": 32, "rounds": 8, "slots": 4, "fanout": 2},
+        "families": [{
+            "name": "f", "scenario": str(scen), "seeds": 2,
+            "sweeps": [{"axis": "phase.loss", "dist": "uniform",
+                        "lo": 0.1, "hi": 0.5}],
+        }],
+    })
+    with pytest.raises(fleet.CampaignError, match="matched no phase"):
+        fleet.compile_campaign(spec)
+
+
+def test_reject_bound_sweep_without_controller():
+    with pytest.raises(fleet.CampaignError, match="control"):
+        fleet.compile_campaign(fleet.campaign_from_dict({
+            "name": "b", "seed": 0,
+            "base": {"peers": 32, "rounds": 8, "slots": 4, "fanout": 2},
+            "families": [{
+                "name": "f", "seeds": 2,
+                "sweeps": [{"axis": "control.hi", "dist": "linspace",
+                            "lo": 2, "hi": 4}],
+            }],
+        }))
+
+
+def test_cli_exit_2_on_bad_campaign(tmp_path, capsys):
+    from tpu_gossip.cli.run_sim import main
+
+    bad = tmp_path / "bad.toml"
+    bad.write_text(
+        "[campaign]\nname = \"bad\"\n[base]\npeers = 16\nrounds = 4\n"
+        "[[family]]\nname = \"f\"\nseeds = 4\n"
+        "[[family.sweep]]\naxis = \"peers\"\ndist = \"uniform\"\n"
+        "lo = 16\nhi = 64\n"
+    )
+    assert main(["fleet", str(bad)]) == 2
+    assert "unknown sampled axis" in capsys.readouterr().err
+
+
+def test_cli_exit_2_on_missing_campaign(capsys):
+    from tpu_gossip.cli.run_sim import main
+
+    assert main(["fleet", "/nonexistent/campaign.toml"]) == 2
+
+
+def test_fleet_salt_registered():
+    from tpu_gossip.core.streams import registered_salts
+
+    assert fleet.FLEET_STREAM_SALT in registered_salts()
+    assert registered_salts()[fleet.FLEET_STREAM_SALT] == "fleet"
+
+
+def test_stack_states_roundtrip_and_pricing():
+    from tpu_gossip.core.state import (
+        SwarmConfig, init_swarm, lane_state, stack_states,
+        state_bytes_per_peer,
+    )
+    from tpu_gossip.core.topology import build_csr, preferential_attachment
+
+    rng = np.random.default_rng(0)
+    g = build_csr(32, preferential_attachment(32, m=2, rng=rng))
+    cfg = SwarmConfig(n_peers=32, msg_slots=4)
+    sts = [init_swarm(g, cfg, key=jax.random.key(k), origins=[k])
+           for k in range(3)]
+    b = stack_states(sts)
+    assert b.seen.shape == (3, 32, 4)
+    back = lane_state(b, 1)
+    np.testing.assert_array_equal(np.asarray(back.seen),
+                                  np.asarray(sts[1].seen))
+    # batch-rank pricing: stacking adds no per-peer overhead
+    assert state_bytes_per_peer(1000, 16, lanes=8) == pytest.approx(
+        state_bytes_per_peer(1000, 16)
+    )

@@ -176,8 +176,10 @@ def _check_matrix_entries(check_name: str) -> list:
             out_st, out_stats = out
         _diff_specs(name, _spec_tree(out_st), _spec_tree(te.state), problems)
         if out_stats is not None:
+            # msg_slots is the seen plane's LAST axis — (N, M) solo,
+            # (K, N, M) at batch rank (the fleet entry)
             _stats_contract(out_stats, problems, leading=ep.stats_leading,
-                            msg_slots=te.state.seen.shape[1])
+                            msg_slots=te.state.seen.shape[-1])
         if ici is not None:
             _ici_contract(name, ici, problems)
     return problems

@@ -41,6 +41,9 @@ _MSG_SLOTS = (1, 16)  # one word group / multi-slot packed group
 _MODES = ("push", "push_pull", "flood")
 _SIM_ROUNDS = 3  # simulate's stacked-stats leading dim
 _DIST_SIM_ROUNDS = 2
+_FLEET_LANES = 3  # batched campaign lanes (fleet/)
+_FLEET_PEERS = 64
+_FLEET_ROUNDS = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -499,6 +502,60 @@ def _local_entries() -> list[EntryPoint]:
         audit_check="simulate_and_coverage", build=build_cov,
         stats_leading=None, jit_name="run_until_coverage",
         n_peers=ctx["dg"].n_pad,
+    ))
+
+    # the BATCHED fleet entry (fleet/): a composed scenario×stream×
+    # control campaign vmapped over _FLEET_LANES lanes — the batched
+    # round must stay a state fixed point AT BATCH RANK (the stacked
+    # state rides the scan carry), the stats contract holds with the
+    # (K, R) leading dims, and the donating jit covers every batched
+    # state leaf; the deep tiers trace the vmapped composed round's full
+    # lineage (four parallel fold_in streams per lane)
+    def build_fleet():
+        from tpu_gossip.fleet import engine as fleet_eng
+        from tpu_gossip.fleet import plan as fleet_plan
+
+        spec = fleet_plan.campaign_from_dict({
+            "name": "audit-fleet", "seed": 0,
+            "base": {
+                "peers": _FLEET_PEERS, "rounds": _FLEET_ROUNDS,
+                "slots": 16, "fanout": 1, "mode": "push_pull",
+                "stream_rate": 1.0, "slot_ttl": 12,
+                "control": 0.9, "control_hi": 3, "rewire_slots": 3,
+                "churn_join": 0.02,
+            },
+            "families": [{
+                "name": "chaos",
+                "scenario": {
+                    "name": "audit-fleet-chaos",
+                    "phases": [
+                        {"name": "lossy", "start": 0, "end": 1,
+                         "loss": 0.2, "delay": 0.2},
+                        {"name": "split", "start": 1, "end": 2,
+                         "partition": "half",
+                         "blackout": {"frac": 0.1, "seed": 1}},
+                    ],
+                },
+                "seeds": _FLEET_LANES,
+                "sweeps": [{"axis": "phase.loss", "dist": "uniform",
+                            "lo": 0.1, "hi": 0.4}],
+            }],
+        })
+        camp = fleet_plan.compile_campaign(spec)
+        return (
+            lambda s: fleet_eng.simulate_fleet(
+                s, camp.cfg, camp.rounds, camp.scenario, camp.growth,
+                camp.stream, camp.control,
+            ),
+            camp.states,
+        )
+
+    eps.append(EntryPoint(
+        name="fleet[simulate,composed]", engine="xla", kind="simulate",
+        audit_check="simulate_and_coverage", build=build_fleet,
+        stats_leading=(_FLEET_LANES, _FLEET_ROUNDS),
+        jit_name="simulate_fleet",
+        n_peers=_FLEET_LANES * _FLEET_PEERS,
     ))
     return eps
 

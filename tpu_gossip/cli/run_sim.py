@@ -250,6 +250,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "fleet":
+        # the campaign subpath: run_sim fleet campaign.toml — a batched
+        # Monte Carlo certification run (tpu_gossip/fleet/,
+        # docs/fleet_campaigns.md) instead of one swarm
+        return _main_fleet(argv[1:])
     args = build_parser().parse_args(argv)
 
     import jax
@@ -489,6 +495,137 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.checkpoint:
         save_swarm(args.checkpoint, fin)
+    return 0
+
+
+def _main_fleet(argv: list[str]) -> int:
+    """``run_sim fleet campaign.toml``: compile + run a batched Monte
+    Carlo certification campaign (tpu_gossip/fleet/) and emit the
+    certification summary JSON.
+
+    ``--lane K --solo`` instead runs lane K UNBATCHED through the plain
+    ``simulate`` over exactly the plans the batch compiled for it and
+    prints its state/stats digests — the cross-process half of the
+    bit-identity contract (the fleet-smoke CI job compares these against
+    the batched run's ``lane_digests``).
+    """
+    import time as _time
+
+    import jax
+
+    p = argparse.ArgumentParser(
+        prog="run_sim fleet",
+        description="Batched Monte Carlo certification campaigns "
+        "(docs/fleet_campaigns.md)",
+    )
+    p.add_argument("campaign", help="campaign TOML (scenarios/campaigns/)")
+    p.add_argument(
+        "--report", default="", metavar="PATH",
+        help="write the FULL certification report JSON here (per-lane "
+        "detail included; stdout carries the compact summary)",
+    )
+    p.add_argument(
+        "--lane", type=int, default=-1, metavar="K",
+        help="with --solo: the lane to run unbatched",
+    )
+    p.add_argument(
+        "--solo", action="store_true",
+        help="run --lane K serially through sim.engine.simulate over the "
+        "lane's compiled plans and print its digests (the conformance "
+        "oracle; bit-identical to lane K of the batched run)",
+    )
+    p.add_argument("--quiet", action="store_true",
+                   help="omit per-lane digests from the summary row")
+    args = p.parse_args(argv)
+
+    from tpu_gossip import fleet
+    from tpu_gossip.faults import ScenarioError
+
+    try:
+        spec = fleet.parse_campaign(args.campaign)
+        camp = fleet.compile_campaign(spec)
+    except (fleet.CampaignError, ScenarioError, OSError) as e:
+        # a typo'd path, an unknown sampled axis, or a lane that would
+        # change a static shape are all config errors — clean exit 2,
+        # the --scenario rejection convention
+        print(f"fleet: {e}", file=sys.stderr)
+        return 2
+
+    if args.solo:
+        if args.lane < 0:
+            print("fleet: --solo needs --lane K", file=sys.stderr)
+            return 2
+        try:
+            fin, stats = fleet.run_lane_solo(camp, args.lane)
+        except fleet.CampaignError as e:
+            print(f"fleet: {e}", file=sys.stderr)
+            return 2
+        from tpu_gossip.sim import metrics as M
+
+        print(json.dumps({
+            "summary": True, "fleet": "solo", "campaign": camp.name,
+            "lane": args.lane,
+            "state_digest": fleet.state_digest(fin),
+            "stats_digest": fleet.stats_digest(stats),
+            "reliability": M.reliability_report(
+                stats, target_ratio=camp.target_ratio,
+                coverage_target=camp.coverage_target,
+            ),
+        }))
+        return 0
+    if args.lane >= 0:
+        print("fleet: --lane selects the --solo lane; drop it for the "
+              "batched run (every lane runs)", file=sys.stderr)
+        return 2
+
+    # AOT-compile the one batched program, then run the horizon ONCE:
+    # swarm_rounds_per_sec is the batching headline and a compile inside
+    # it would be noise, but a full warm EXECUTION would double every
+    # campaign's compute for a timing field — lowering compiles without
+    # running, and the compiled executable is invoked directly (the jit
+    # call cache is not populated by AOT compilation)
+    compiled = fleet.simulate_fleet.lower(
+        camp.states, camp.cfg, camp.rounds, camp.scenario, camp.growth,
+        camp.stream, camp.control,
+    ).compile()
+    t0 = _time.perf_counter()
+    # the donating path: the CLI never touches camp.states again (lane
+    # digests read the returned final states; --solo is its own process)
+    fin, stats = compiled(
+        camp.states, camp.scenario, camp.growth, camp.stream, camp.control
+    )
+    float(fin.round[0])  # fetch = completion barrier
+    wall = _time.perf_counter() - t0
+    camp.states, camp.consumed = fin, True  # the input was donated
+
+    report = fleet.campaign_report(camp, stats)
+    summary = {
+        "summary": True, "fleet": True, "campaign": camp.name,
+        "lanes": camp.k, "rounds": camp.rounds,
+        "n_peers": int(camp.base.get("peers", 0)),
+        "wall_seconds": round(wall, 3),
+        "swarm_rounds_per_sec": round(camp.k * camp.rounds / max(wall, 1e-9), 2),
+        "families": [
+            {k: f.get(k) for k in (
+                "family", "lanes", "lanes_judged", "reliability",
+                "frontier",
+            ) if f.get(k) is not None}
+            for f in report["families"]
+        ],
+    }
+    if not args.quiet:
+        summary["lane_digests"] = {
+            str(k): fleet.state_digest(jax.tree.map(lambda x: x[k], fin))
+            for k in range(camp.k)
+        }
+        summary["stats_digests"] = {
+            str(k): fleet.stats_digest(stats, k) for k in range(camp.k)
+        }
+    print(json.dumps(summary))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
     return 0
 
 

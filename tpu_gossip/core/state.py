@@ -49,6 +49,8 @@ __all__ = [
     "state_bytes_per_peer",
     "init_swarm",
     "clone_state",
+    "stack_states",
+    "lane_state",
     "message_slot",
     "message_slots",
     "save_swarm",
@@ -140,19 +142,24 @@ def _dtype_bytes(dtype: str) -> int:
 
 
 def state_plane_bytes(
-    n: int, m: int, rewire_slots: int = 1, d: int | None = None
+    n: int, m: int, rewire_slots: int = 1, d: int | None = None,
+    lanes: int = 1,
 ) -> dict:
     """Declared bytes per plane at (N=n, M=m, S=rewire_slots, D=d).
 
     ``d`` (edge slots) defaults to 0 — topology residency depends on the
     generator, so callers quoting a full swarm pass their edge count;
     the per-peer STATE metric the ROADMAP tracks excludes it either way.
+    ``lanes`` prices the registry at batch rank: a fleet campaign
+    (fleet/) stacks ``lanes`` independent swarms into one batched pytree,
+    and every plane — scalars and the CSR included, since each lane's
+    state owns its leaves — materializes ``lanes`` copies.
     """
     d = 0 if d is None else d
     dims = {"N": n, "M": m, "S": max(rewire_slots, 1), "D": d}
     out = {}
     for p in PLANES:
-        elems = 1
+        elems = max(lanes, 1)
         for term in p.shape.strip("()").split(","):
             term = term.strip()
             if not term:
@@ -166,14 +173,21 @@ def state_plane_bytes(
 
 
 def state_bytes_per_peer(
-    n: int, m: int, rewire_slots: int = 1, d: int | None = None
+    n: int, m: int, rewire_slots: int = 1, d: int | None = None,
+    lanes: int = 1,
 ) -> float:
     """The ROADMAP's tracked metric: declared state bytes per peer slot.
 
     Pure registry arithmetic — no arrays are built, so it is quotable at
     any n (bench.py records it at 1M; the 100M item budgets against it).
+    With ``lanes`` > 1 the denominator is the AGGREGATE peer-slot count
+    ``lanes * n`` — a batched campaign's bytes/peer equals the solo
+    figure (stacking adds no per-peer overhead; only the per-lane
+    scalars amortize differently, a rounding-level effect).
     """
-    return sum(state_plane_bytes(n, m, rewire_slots, d).values()) / n
+    return sum(
+        state_plane_bytes(n, m, rewire_slots, d, lanes).values()
+    ) / (n * max(lanes, 1))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -472,6 +486,27 @@ def clone_state(state: SwarmState) -> SwarmState:
     explicitly where the old engine paid it invisibly on every call.
     """
     return jax.tree.map(lambda leaf: leaf.copy(), state)
+
+
+def stack_states(states: list["SwarmState"]) -> "SwarmState":
+    """Stack K per-lane states into one batched pytree (leaf axis 0).
+
+    The fleet engine (fleet/engine.py) vmaps the protocol round over the
+    stacked state — every leaf gains a leading lane axis, scalars and the
+    PRNG key included. All lanes must share static shapes (same n, m,
+    rewire width — the campaign compiler's shared-static-shape rule).
+    ``jnp.stack`` COPIES, so the batched state owns its leaves and the
+    donating fleet entry points can never delete a caller's solo state.
+    """
+    if not states:
+        raise ValueError("stack_states needs at least one lane state")
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *states)
+
+
+def lane_state(batched: "SwarmState", k: int) -> "SwarmState":
+    """Extract lane ``k`` of a :func:`stack_states` pytree (leaf copies,
+    so the lane survives a later donation of the batch)."""
+    return jax.tree.map(lambda leaf: leaf[k].copy(), batched)
 
 
 def message_slot(message_id: int | str, msg_slots: int) -> int:

@@ -33,6 +33,7 @@ __all__ = [
     "GROWTH_STREAM_SALT",
     "TRAFFIC_STREAM_SALT",
     "CONTROL_STREAM_SALT",
+    "FLEET_STREAM_SALT",
     "register_stream",
     "registered_salts",
 ]
@@ -78,14 +79,23 @@ def registered_salts() -> dict[int, str]:
 
 
 # the canonical stream map (keep docs/fault_model.md + docs/growth_engine.md
-# + docs/streaming_plane.md + docs/adaptive_control.md tables in sync):
+# + docs/streaming_plane.md + docs/adaptive_control.md +
+# docs/fleet_campaigns.md tables in sync):
 #
 #   stream   salt         consumer                         draws
 #   fault    0x5CE7A510   faults/inject.py (scenarios)     loss/delay/blackout
 #   growth   0x9087A110   growth/engine.py (admission)     Gumbel-top-k targets
 #   traffic  0x7AFF1C00   traffic/engine.py (injection)    arrivals/origins/slots
 #   control  0xC0274201   control/engine.py (PeerSwap)     neighbor-refresh swaps
+#   fleet    0xF1EE7C42   fleet/plan.py (campaign lanes)   per-lane root keys
 FAULT_STREAM_SALT = register_stream("fault", 0x5CE7A510)
 GROWTH_STREAM_SALT = register_stream("growth", 0x9087A110)
 TRAFFIC_STREAM_SALT = register_stream("traffic", 0x7AFF1C00)
 CONTROL_STREAM_SALT = register_stream("control", 0xC0274201)
+# lane k of a Monte Carlo campaign (fleet/) runs on root key
+# fold_in(fold_in(campaign_key, FLEET_STREAM_SALT), k): the salted parent
+# is consumed ONLY by the per-lane folds (nothing ever splits it), so a
+# small lane index can never alias a split child, and a solo run seeded
+# with the same derived lane key reproduces lane k of the batch bit for
+# bit (the fleet conformance contract, tests/sim/test_fleet.py)
+FLEET_STREAM_SALT = register_stream("fleet", 0xF1EE7C42)

@@ -1,0 +1,54 @@
+"""Fleet engine: vmapped Monte Carlo certification campaigns.
+
+``fleet/plan.py`` compiles a campaign TOML — base run config + sampled
+axes over scenario families — into a :class:`CompiledCampaign` of K
+per-swarm plans stacked into one batched pytree (shared static shapes);
+``fleet/engine.py`` vmaps the shared protocol round driver over the
+stack (one compile serves all K lanes, each bit-identical to its solo
+run); ``fleet/metrics.py`` reduces the per-lane trajectories into
+certification reports — reliability quantiles with bootstrap CIs per
+scenario family, rounds-to-coverage distributions, and contract-break
+frontiers for swept controller bounds. docs/fleet_campaigns.md has the
+schema, the shared-static-shape rule, and the determinism contract.
+"""
+
+from tpu_gossip.core.streams import FLEET_STREAM_SALT
+from tpu_gossip.fleet.engine import (
+    run_campaign,
+    run_lane_solo,
+    simulate_fleet,
+    state_digest,
+    stats_digest,
+)
+from tpu_gossip.fleet.metrics import campaign_report, lane_stats
+from tpu_gossip.fleet.plan import (
+    CampaignError,
+    CampaignSpec,
+    CompiledCampaign,
+    FamilySpec,
+    SweepAxis,
+    SWEEP_AXES,
+    campaign_from_dict,
+    compile_campaign,
+    parse_campaign,
+)
+
+__all__ = [
+    "FLEET_STREAM_SALT",
+    "CampaignError",
+    "CampaignSpec",
+    "CompiledCampaign",
+    "FamilySpec",
+    "SweepAxis",
+    "SWEEP_AXES",
+    "campaign_from_dict",
+    "compile_campaign",
+    "parse_campaign",
+    "simulate_fleet",
+    "run_campaign",
+    "run_lane_solo",
+    "state_digest",
+    "stats_digest",
+    "campaign_report",
+    "lane_stats",
+]

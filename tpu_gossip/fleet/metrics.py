@@ -1,0 +1,228 @@
+"""Certification reports: per-lane trajectories → fleet-level statistics.
+
+The whole point of a campaign is error bars: one trajectory per TOML
+certifies nothing. This module reduces the ``(K, rounds, ...)`` stats a
+batched run produces into the certification artifacts the ROADMAP's
+scenario-diversity item names:
+
+- **reliability quantiles with bootstrap confidence intervals** per
+  scenario family (the delivery-ratio frame of *Reliable Probabilistic
+  Gossip*, PAPERS.md) — and per *phase-parameter bin* when a family
+  sweeps a fault-phase axis, so "how does delivery degrade with loss?"
+  is a curve with CIs, not an anecdote;
+- **rounds-to-coverage distributions** (p50/p99 per lane, distributed
+  over the family);
+- a **contract-break frontier** for swept controller bounds: the
+  bound value where the declared delivery-ratio target stops holding —
+  the AIMD-bound question the adaptive-control plane left open.
+
+Everything is host-side numpy over the already-fetched stats (the
+sim.metrics pattern); per-lane judgments reuse
+``sim.metrics.reliability_report`` verbatim, so a fleet lane and a solo
+run are judged by the SAME code path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "lane_stats",
+    "campaign_report",
+]
+
+_QUANTILES = (5, 25, 50, 75, 95)
+_BOOTSTRAP = 500
+
+
+def lane_stats(stats, k: int):
+    """Lane ``k``'s ``(rounds, ...)`` slice of batched ``(K, rounds, ...)``
+    stats — the shape every sim.metrics reporting helper consumes."""
+    return type(stats)(*(np.asarray(f)[k] for f in stats))
+
+
+def _quantile_block(values: np.ndarray, rng: np.random.Generator) -> dict:
+    """Quantiles + a bootstrap 95% CI of the mean over one lane set."""
+    v = np.asarray(values, dtype=np.float64)
+    boot = np.asarray([
+        rng.choice(v, size=v.size, replace=True).mean()
+        for _ in range(_BOOTSTRAP)
+    ])
+    return {
+        "lanes": int(v.size),
+        "mean": round(float(v.mean()), 4),
+        "quantiles": {
+            f"p{q:02d}": round(float(np.percentile(v, q)), 4)
+            for q in _QUANTILES
+        },
+        "bootstrap_ci95_mean": [
+            round(float(np.percentile(boot, 2.5)), 4),
+            round(float(np.percentile(boot, 97.5)), 4),
+        ],
+    }
+
+
+def _frontier(axis: str, values, ratios, target: float) -> dict:
+    """The contract-break frontier of a swept controller bound: group
+    lanes by bound value, mark each value held/broken by its mean
+    delivery ratio vs ``target``, and report the boundary. ``found`` is
+    True when the sweep actually LOCATED a break (some value breaks,
+    some value holds) — a sweep that holds or breaks everywhere reports
+    its one-sided truth instead of inventing a frontier."""
+    values = np.asarray(values, dtype=np.float64)
+    ratios = np.asarray(ratios, dtype=np.float64)
+    table = []
+    for v in np.unique(values):
+        r = ratios[values == v]
+        table.append({
+            "value": round(float(v), 4),
+            "lanes": int(r.size),
+            "delivery_ratio_mean": round(float(r.mean()), 4),
+            "holds": bool(r.mean() >= target),
+        })
+    breaks = [t["value"] for t in table if not t["holds"]]
+    holds = [t["value"] for t in table if t["holds"]]
+    # noisy few-seed sweeps can be non-monotone (a break value above a
+    # holding one): first_hold is the smallest holding value ABOVE the
+    # last break when one exists, else None — never a crash on a sweep
+    # whose top value broke
+    above = [v for v in holds if not breaks or v > max(breaks)]
+    return {
+        "axis": axis,
+        "target_ratio": float(target),
+        "per_value": table,
+        "found": bool(breaks and holds),
+        "last_break": max(breaks) if breaks else None,
+        "first_hold": min(above) if above else None,
+    }
+
+
+def campaign_report(
+    campaign, stats, *, bins: int = 4, bootstrap_seed: int = 0,
+) -> dict:
+    """The certification report of one campaign run.
+
+    ``stats`` is :func:`~tpu_gossip.fleet.engine.run_campaign`'s batched
+    stats. Per family: the per-lane reliability judgments (via
+    ``sim.metrics.reliability_report`` — the exact code path a solo run
+    is certified by), the family's delivery-ratio quantile block with a
+    bootstrap CI, rounds-to-coverage distributions, per-bin blocks for
+    each swept phase/stream axis (``bins`` equal-width bins over the
+    sampled range), and the contract-break frontier for swept
+    ``control.*`` axes. Deterministic: the bootstrap rng is seeded.
+    """
+    from tpu_gossip.sim import metrics as SM
+
+    rng = np.random.default_rng([bootstrap_seed, campaign.k])
+    per_lane = []
+    for lane in campaign.lanes:
+        rep = SM.reliability_report(
+            lane_stats(stats, lane.index),
+            target_ratio=campaign.target_ratio,
+            coverage_target=campaign.coverage_target,
+        )
+        per_lane.append({
+            "lane": lane.index,
+            "family": lane.family,
+            "sampled": lane.sampled,
+            "delivery_ratio": rep["delivery_ratio"],
+            "holds": rep["holds"],
+            "messages_judged": rep["messages_judged"],
+            "msgs_per_delivered_infection":
+                rep["msgs_per_delivered_infection"],
+            "rounds_to_coverage": rep["rounds_to_coverage"],
+            "peak_coverage": rep["peak_coverage"],
+        })
+
+    families = []
+    for fam in campaign.families:
+        rows = [r for r in per_lane if r["family"] == fam.name]
+        # a lane whose horizon judged nothing (delivery_ratio None) is
+        # vacuous — excluded from the quantile math, counted explicitly
+        judged = [r for r in rows if r["delivery_ratio"] is not None]
+        ratios = np.asarray([r["delivery_ratio"] for r in judged])
+        block = {
+            "family": fam.name,
+            "scenario": fam.scenario_label,
+            "lanes": len(rows),
+            "lanes_judged": len(judged),
+            "target_ratio": campaign.target_ratio,
+            "coverage_target": campaign.coverage_target,
+        }
+        if judged:
+            rel = _quantile_block(ratios, rng)
+            rel["holds_fraction"] = round(
+                float(np.mean([r["holds"] for r in judged])), 4
+            )
+            # the certified verdict: the bootstrap CI's LOWER bound
+            # clears the target — one lucky lane cannot certify a family
+            rel["holds"] = bool(rel["mean"] >= campaign.target_ratio)
+            rel["certified"] = bool(
+                rel["bootstrap_ci95_mean"][0] >= campaign.target_ratio
+            )
+            block["reliability"] = rel
+            p50s = [
+                r["rounds_to_coverage"]["p50"] for r in judged
+                if r["rounds_to_coverage"]["p50"] is not None
+            ]
+            p99s = [
+                r["rounds_to_coverage"]["p99"] for r in judged
+                if r["rounds_to_coverage"]["p99"] is not None
+            ]
+            block["rounds_to_coverage"] = {
+                "p50_over_lanes": (
+                    _quantile_block(np.asarray(p50s), rng) if p50s else None
+                ),
+                "p99_over_lanes": (
+                    _quantile_block(np.asarray(p99s), rng) if p99s else None
+                ),
+            }
+        sweep_blocks = []
+        frontiers = []
+        for ax in fam.sweeps:
+            vals = np.asarray([r["sampled"][ax.axis] for r in judged])
+            if not judged:
+                continue
+            if ax.axis.startswith("control."):
+                frontiers.append(_frontier(
+                    ax.axis, vals, ratios, campaign.target_ratio
+                ))
+                continue
+            # equal-width bins over the family's realized sample range:
+            # the per-phase-parameter reliability curve with CIs
+            lo, hi = float(vals.min()), float(vals.max())
+            edges = np.linspace(lo, hi, num=min(bins, len(judged)) + 1)
+            bin_rows = []
+            for i in range(len(edges) - 1):
+                sel = (vals >= edges[i]) & (
+                    vals <= edges[i + 1] if i == len(edges) - 2
+                    else vals < edges[i + 1]
+                )
+                if not sel.any():
+                    continue
+                bin_rows.append({
+                    "range": [round(float(edges[i]), 4),
+                              round(float(edges[i + 1]), 4)],
+                    **_quantile_block(ratios[sel], rng),
+                })
+            sweep_blocks.append({
+                "axis": ax.axis, "dist": ax.dist, "bins": bin_rows,
+            })
+        if sweep_blocks:
+            block["sweeps"] = sweep_blocks
+        if frontiers:
+            block["frontier"] = frontiers[0]
+            if len(frontiers) > 1:
+                # a family sweeping several control.* axes gets every
+                # frontier; "frontier" stays the first axis's block
+                block["frontiers"] = frontiers
+        families.append(block)
+
+    return {
+        "campaign": campaign.name,
+        "lanes": campaign.k,
+        "rounds": campaign.rounds,
+        "n_peers": int(campaign.base.get("peers", 0)),
+        "families": families,
+        "lanes_detail": per_lane,
+    }

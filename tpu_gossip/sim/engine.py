@@ -16,7 +16,11 @@ fixed horizon (full per-round metric history), :func:`run_until_coverage` a
 no host round-trips until the loop exits). Both jit once per
 (config, shapes) and are sharding-agnostic: under a
 ``jax.sharding.Mesh`` the same code runs 1-D sharded on the peer axis
-(dist/mesh.py).
+(dist/mesh.py) — and BATCH-agnostic: the fleet engine
+(fleet/engine.py::simulate_fleet) vmaps :func:`gossip_round` over K
+stacked swarms with per-lane compiled plans, each lane bit-identical to
+its solo run (the Monte Carlo certification path,
+docs/fleet_campaigns.md).
 """
 
 from __future__ import annotations

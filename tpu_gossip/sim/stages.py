@@ -25,6 +25,16 @@ The declared-carry enforcement is pure Python over the traced values
 op-for-op the one the hand-ordered sequence produced — the refactor is
 bit-exact by construction (the tier-1 parity matrix pins it).
 
+BATCH RANK: the fleet engine (fleet/engine.py) ``jax.vmap``s
+:func:`run_protocol_round` over a stacked lane axis — K independent
+swarms per campaign, one compile. Every stage must therefore stay
+RANK-POLYMORPHIC: shapes only through ``.shape``/``jnp`` ops, no host
+scalars derived from traced values, no global state — exactly the
+trace-purity rules graftlint already enforces, which is why the whole
+composed stage list (faults, growth, stream, control) vmaps unchanged.
+A new stage that breaks this breaks the fleet's lane↔solo bit-identity
+contract (tests/sim/test_fleet.py pins it at composed cells).
+
 Pipelined rounds (docs/pipelined_rounds.md): :func:`compile_pipeline`
 builds a :class:`PipelineSpec`. At ``depth=1`` the driver DOUBLE-BUFFERS
 the exchange: the dissemination (collective) for the CURRENT transmit
