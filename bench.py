@@ -1116,6 +1116,67 @@ def bench_fleet(n: int = 131072, ks=(1, 8, 32), rounds: int = 10,
     }
 
 
+def bench_ckpt(n: int = 1_000_000, shards: int = 8, msg_slots: int = 16,
+               warm_rounds: int = 4):
+    """Durable checkpoint save/restore at headline scale (tpu_gossip/
+    ckpt/, docs/checkpointing.md): one warmed 1M swarm written as a
+    ``shards``-file atomic checkpoint (manifest-last, sha256 per file),
+    read back, digest-verified bit-exact. Records save/restore wall
+    seconds, total bytes, and MB/s both ways — the numbers that price
+    --checkpoint-every: a checkpoint cadence costs ``save_seconds``
+    per K rounds of horizon, and a crash costs ``restore_seconds``
+    instead of the whole replay the reference's config.txt re-bootstrap
+    amounts to (PARITY.md)."""
+    import shutil as _shutil
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from tpu_gossip.ckpt import load_checkpoint, save_checkpoint
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.state import SwarmConfig, init_swarm
+    from tpu_gossip.fleet.engine import state_digest
+    from tpu_gossip.sim.engine import simulate
+
+    dg = device_powerlaw_graph(n, gamma=2.5, key=jax.random.key(7))
+    cfg = SwarmConfig(
+        n_peers=dg.n_pad, msg_slots=msg_slots, fanout=2, mode="push_pull"
+    )
+    state = init_swarm(
+        dg.as_padded_graph(), cfg, exists=dg.exists, key=jax.random.key(7),
+        origins=[0],
+    )
+    state, _ = simulate(state, cfg, warm_rounds)  # mid-epidemic planes
+    tmp = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        t0 = _time.perf_counter()
+        ckdir = save_checkpoint(tmp, state, step=warm_rounds, shards=shards)
+        save_s = _time.perf_counter() - t0
+        total_bytes = sum(
+            p.stat().st_size for p in ckdir.iterdir() if p.is_file()
+        )
+        t0 = _time.perf_counter()
+        restored, _stats, _manifest = load_checkpoint(ckdir)
+        restore_s = _time.perf_counter() - t0
+        bit_exact = state_digest(restored) == state_digest(state)
+    finally:
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "n_peers": n,
+        "msg_slots": msg_slots,
+        "shards": shards,
+        "checkpoint_bytes": int(total_bytes),
+        "save_seconds": round(save_s, 3),
+        "restore_seconds": round(restore_s, 3),
+        "save_mb_per_s": round(total_bytes / 1e6 / max(save_s, 1e-9), 1),
+        "restore_mb_per_s": round(
+            total_bytes / 1e6 / max(restore_s, 1e-9), 1
+        ),
+        "restore_bit_exact": bool(bit_exact),
+    }
+
+
 def _lint_status(deep: bool = True) -> dict:
     """graftlint verdict for the tree being benchmarked. AST rules run
     in-process (sub-second); the combined run — rules + contract audit +
@@ -1600,7 +1661,7 @@ def main(argv: list[str] | None = None) -> int:
         frac = {"tail_ab": 0.35, "north_star_10m": 0.40, "dist_200k": 0.70,
                 "dist_1m": 0.78, "grow_1m": 0.82, "stream_1m": 0.86,
                 "control_1m": 0.88, "pipeline_1m": 0.89,
-                "fleet_1m": 0.895, "dist_10m": 0.90}[section]
+                "ckpt_1m": 0.893, "fleet_1m": 0.895, "dist_10m": 0.90}[section]
         if elapsed() <= budget_s * frac:
             return False
         out["sections_skipped"].append(
@@ -1907,6 +1968,12 @@ def main(argv: list[str] | None = None) -> int:
             # the extended profiler's per-stage overlap attribution
             out["pipeline_1m"] = bench_pipeline(1_000_000, reps=reps)
             flush_detail()
+        if not quick and not skip("ckpt_1m"):
+            # durable-checkpoint save/restore wall + bytes at 1M — the
+            # price of --checkpoint-every and of a crash (ckpt/,
+            # docs/checkpointing.md); restore is digest-verified
+            out["ckpt_1m"] = bench_ckpt(1_000_000)
+            flush_detail()
         if not quick and not skip("fleet_1m"):
             # the fleet engine at aggregate-1M scale: ONE vmapped
             # campaign program vs K serial runs (in-process floor AND
@@ -2049,6 +2116,14 @@ def _compact(out: dict) -> dict:
             "swarms_per_sec_k8": k8.get("batched_swarms_per_sec"),
             "speedup_k8_vs_processes": fl.get("headline_speedup_k8"),
             "speedup_k8_inprocess": fl.get("headline_speedup_k8_inprocess"),
+        }
+    ck = out.get("ckpt_1m")
+    if ck and "save_seconds" in ck:
+        compact["ckpt_1m"] = {
+            "save_s": ck["save_seconds"],
+            "restore_s": ck["restore_seconds"],
+            "mb": round(ck["checkpoint_bytes"] / 1e6, 1),
+            "bit_exact": ck["restore_bit_exact"],
         }
     pl = out.get("pipeline_1m")
     if pl and "serial" in pl:

@@ -115,7 +115,8 @@ def test_registry_bytes_per_peer_arithmetic():
     # hand sums at (N=100, M=16, S=1): five (N, M) bool planes, one
     # (N, M) int32, five (N,) bool, int32/int16 rows, scalars
     assert by_plane["seen"] == 100 * 16
-    assert by_plane["infected_round"] == 100 * 16 * 4
+    assert by_plane["infected_round"] == 100 * 16 * 2  # narrowed int16
+    assert by_plane["last_hb"] == 100 * 2  # narrowed int16
     assert by_plane["join_round"] == 100 * 2  # the narrowed plane
     assert by_plane["slot_lease"] == 16 * 2
     assert by_plane["row_ptr"] == 101 * 4
@@ -128,6 +129,8 @@ def test_narrowed_planes_materialize_declared_widths():
     te = _traced("local[xla,push,m=1]")
     assert str(te.state.join_round.dtype) == "int16"
     assert str(te.state.slot_lease.dtype) == "int16"
+    assert str(te.state.infected_round.dtype) == "int16"
+    assert str(te.state.last_hb.dtype) == "int16"
 
 
 def test_entry_ledger_state_bytes_match_flattened_leaves():
@@ -383,11 +386,11 @@ def test_round_cap_saturates_narrow_plane_writes():
         rate=50.0, msg_slots=4, ttl=4, origin_rows=np.arange(4)
     )
     ones = jnp.ones((4,), bool)
-    _, _, lease, _ = apply_stream(
+    _, ir, lease, _ = apply_stream(
         sp, jax.random.key(0),
         jnp.asarray(ROUND_CAP + 100, jnp.int32), jnp.asarray(0, jnp.int32),
         seen=jnp.zeros((4, 4), bool),
-        infected_round=jnp.full((4, 4), -1, jnp.int32),
+        infected_round=jnp.full((4, 4), -1, jnp.int16),
         slot_lease=jnp.full((4,), -1, jnp.int16),
         row_ptr=jnp.zeros((5,), jnp.int32),
         col_idx=jnp.zeros((1,), jnp.int32),
@@ -396,6 +399,9 @@ def test_round_cap_saturates_narrow_plane_writes():
     lease = np.asarray(lease)
     assert (lease >= 0).any(), "rate 50 over 4 slots must land something"
     assert (lease[lease >= 0] == ROUND_CAP).all()
+    ir = np.asarray(ir)
+    assert str(ir.dtype) == "int16"
+    assert (ir[ir >= 0] == ROUND_CAP).all(), "injection latch must saturate"
 
     from tpu_gossip.growth import compile_growth
     from tpu_gossip.growth.engine import apply_growth
@@ -410,7 +416,7 @@ def test_round_cap_saturates_narrow_plane_writes():
         jnp.asarray(0, jnp.int32),
         row_ptr=jnp.asarray(np.arange(n + 1) * 2, jnp.int32),
         exists=exists, alive=exists, silent=jnp.zeros((n,), bool),
-        last_hb=jnp.zeros((n,), jnp.int32), declared_dead=~exists,
+        last_hb=jnp.zeros((n,), jnp.int16), declared_dead=~exists,
         rewired=jnp.zeros((n,), bool),
         rewire_targets=jnp.full((n, 1), -1, jnp.int32),
         join_round=jnp.where(exists, 0, -1).astype(jnp.int16),
@@ -420,6 +426,32 @@ def test_round_cap_saturates_narrow_plane_writes():
     jr = np.asarray(out["join_round"])
     joined = jr[np.asarray(out["exists"]) & ~np.asarray(exists)]
     assert joined.size and (joined == ROUND_CAP).all(), jr
+    hb = np.asarray(out["last_hb"])
+    assert str(hb.dtype) == "int16"
+    admitted = hb[np.asarray(out["exists"]) & ~np.asarray(exists)]
+    assert (admitted == ROUND_CAP).all(), "admission heartbeat must saturate"
+
+    # the heartbeat refresh and the dedup latch saturate the same way
+    from tpu_gossip.kernels.liveness import emit_heartbeats
+    from tpu_gossip.kernels.round_tail import round_tail
+
+    ones4 = jnp.ones((4,), bool)
+    hb2 = emit_heartbeats(
+        jnp.zeros((4,), jnp.int16), ones4, ~ones4, jnp.zeros((4,), bool),
+        jnp.asarray(ROUND_CAP + 100, jnp.int32), 1,
+    )
+    assert str(hb2.dtype) == "int16" and (np.asarray(hb2) == ROUND_CAP).all()
+    for impl in ("fused", "reference", "pallas"):
+        _, _, ir2, _ = round_tail(
+            jnp.zeros((4, 2), bool), jnp.zeros((4, 2), bool),
+            jnp.full((4, 2), -1, jnp.int16), jnp.zeros((4, 2), bool),
+            jnp.ones((4, 2), bool), jnp.ones((4, 2), bool),
+            jnp.zeros((4, 2), bool), None,
+            jnp.asarray(ROUND_CAP + 100, jnp.int32),
+            forward_once=False, sir_recover_rounds=0, impl=impl,
+        )
+        ir2 = np.asarray(ir2)
+        assert str(ir2.dtype) == "int16" and (ir2 == ROUND_CAP).all(), impl
 
 
 def test_checkpoint_narrow_plane_round_trip(tmp_path):
@@ -433,15 +465,13 @@ def test_checkpoint_narrow_plane_round_trip(tmp_path):
     save_swarm(path, st)
     data = dict(np.load(path))
     # forge the pre-narrowing format: re-widen the planes on disk
-    data["field_join_round"] = data["field_join_round"].astype(np.int32)
-    data["field_slot_lease"] = data["field_slot_lease"].astype(np.int32)
+    for plane in ("join_round", "slot_lease", "infected_round", "last_hb"):
+        data[f"field_{plane}"] = data[f"field_{plane}"].astype(np.int32)
     np.savez(path, **data)
     restored = load_swarm(path)
-    assert str(restored.join_round.dtype) == "int16"
-    assert str(restored.slot_lease.dtype) == "int16"
-    np.testing.assert_array_equal(
-        np.asarray(restored.join_round), np.asarray(st.join_round)
-    )
-    np.testing.assert_array_equal(
-        np.asarray(restored.slot_lease), np.asarray(st.slot_lease)
-    )
+    for plane in ("join_round", "slot_lease", "infected_round", "last_hb"):
+        assert str(getattr(restored, plane).dtype) == "int16", plane
+        np.testing.assert_array_equal(
+            np.asarray(getattr(restored, plane)),
+            np.asarray(getattr(st, plane)),
+        )

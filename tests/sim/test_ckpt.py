@@ -1,0 +1,530 @@
+"""Durable checkpoints (tpu_gossip/ckpt/): sharded atomic round-trips,
+torn-write detection + rollback, bit-exact crash recovery, fleet-rank
+round-trips, legacy-format loading, and the CLI's rejection surface."""
+
+import json
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_gossip.ckpt import (
+    CORRUPTION_MODES,
+    CheckpointError,
+    corrupt_checkpoint,
+    latest_complete,
+    list_checkpoint_steps,
+    load_any,
+    load_checkpoint,
+    next_cut,
+    prune_checkpoints,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from tpu_gossip.core.state import (
+    PLANES,
+    SwarmConfig,
+    init_swarm,
+    lane_state,
+    load_swarm,
+    save_swarm,
+    stack_states,
+)
+from tpu_gossip.core.topology import build_csr, preferential_attachment
+from tpu_gossip.fleet.engine import state_digest, stats_digest
+from tpu_gossip.sim.engine import simulate
+
+
+def small_graph(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_csr(
+        n, preferential_attachment(n, m=2, rng=rng, use_native=False)
+    )
+
+
+def churny_cfg(n=96, **kw):
+    return SwarmConfig(
+        n_peers=n, msg_slots=8, fanout=2,
+        churn_leave_prob=0.05, churn_join_prob=0.3, rewire_slots=2, **kw,
+    )
+
+
+@pytest.fixture()
+def warm_state():
+    g = small_graph()
+    cfg = churny_cfg()
+    st = init_swarm(g, cfg, origins=[0, 3], key=jax.random.key(1))
+    st, stats = simulate(st, cfg, 6)
+    return g, cfg, st, stats
+
+
+# ------------------------------------------------------ store round-trip
+def test_checkpoint_format_covers_every_plane():
+    """Shard/global/CSR membership derives from the PLANES registry, so a
+    future SwarmState plane lands in the format automatically — and this
+    pin makes a plane that somehow escapes all three groups a test
+    failure, not silent data loss."""
+    import dataclasses as _dc
+
+    from tpu_gossip.ckpt.store import (
+        _CSR_PLANES,
+        _global_planes,
+        _row_planes,
+    )
+    from tpu_gossip.core.state import SwarmState
+
+    names = {f.name for f in _dc.fields(SwarmState)}
+    covered = set(_row_planes()) | set(_global_planes()) | set(_CSR_PLANES)
+    assert covered == names, names ^ covered
+
+def test_sharded_roundtrip_is_bit_exact(tmp_path, warm_state):
+    """S shard files + global.npz concatenate back to the EXACT state —
+    every leaf, the PRNG key, and the stats prefix included."""
+    _g, _cfg, st, stats = warm_state
+    stats_d = {f: np.asarray(getattr(stats, f)) for f in stats._fields}
+    save_checkpoint(tmp_path, st, step=6, shards=4, stats=stats_d,
+                    run_config={"peers": 96})
+    st2, stats2, manifest = load_checkpoint(tmp_path / "ckpt-00000006")
+    assert state_digest(st2) == state_digest(st)
+    for f, arr in stats_d.items():
+        np.testing.assert_array_equal(stats2[f], arr)
+    assert manifest["round"] == 6 and manifest["shards"] == 4
+    assert manifest["run"] == {"peers": 96}
+    # the manifest declares every plane at its registry dtype
+    reg = {p.name: p.dtype for p in PLANES}
+    for name, entry in manifest["planes"].items():
+        if reg[name] != "key":
+            assert entry["dtype"] == reg[name], name
+
+
+def test_shard_count_is_a_storage_choice(tmp_path, warm_state):
+    """The resharding contract's file half: the SAME state saved at S=1,
+    S=3 and S=8 loads to identical bits — shard count never leaks into
+    the restored state."""
+    _g, _cfg, st, _stats = warm_state
+    digests = set()
+    for s in (1, 3, 8):
+        d = tmp_path / f"s{s}"
+        save_checkpoint(d, st, step=6, shards=s)
+        st2, _, _ = load_checkpoint(d / "ckpt-00000006")
+        digests.add(state_digest(st2))
+    assert digests == {state_digest(st)}
+
+
+def test_capacity_tail_survives_the_roundtrip(tmp_path):
+    """A re-materialized CSR keeps a capacity tail past row_ptr[-1]; the
+    tail rides global.npz verbatim so the reassembled pair is
+    byte-identical (anything else would break jit shape reuse)."""
+    from tpu_gossip.sim.engine import remat_capacity, rematerialize_rewired
+
+    g = small_graph()
+    cfg = churny_cfg()
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(2))
+    cap = remat_capacity(st, cfg)
+    st, _ = simulate(st, cfg, 5)
+    st, _overflow = rematerialize_rewired(st, cfg, cap)
+    assert int(st.col_idx.shape[0]) > int(np.asarray(st.row_ptr)[-1])
+    save_checkpoint(tmp_path, st, step=5, shards=3)
+    st2, _, _ = load_checkpoint(tmp_path / "ckpt-00000005")
+    np.testing.assert_array_equal(np.asarray(st2.col_idx),
+                                  np.asarray(st.col_idx))
+    np.testing.assert_array_equal(np.asarray(st2.row_ptr),
+                                  np.asarray(st.row_ptr))
+
+
+# ------------------------------------------------- torn-write detection
+@pytest.mark.parametrize("mode", CORRUPTION_MODES)
+def test_every_corruption_mode_is_detected_and_rolled_back(
+    tmp_path, warm_state, mode
+):
+    """The acceptance contract: an injected truncation, byte flip,
+    missing manifest, or dropped shard is DETECTED (named reason) and
+    recovery rolls back to the previous complete checkpoint — never
+    loads damage."""
+    _g, cfg, st, _stats = warm_state
+    save_checkpoint(tmp_path, st, step=6, shards=2)
+    st2, _ = simulate(st, cfg, 3)
+    save_checkpoint(tmp_path, st2, step=9, shards=2)
+    early = state_digest(load_checkpoint(tmp_path / "ckpt-00000006")[0])
+
+    corrupt_checkpoint(tmp_path / "ckpt-00000009", mode)
+    with pytest.raises(CheckpointError):
+        verify_checkpoint(tmp_path / "ckpt-00000009")
+    logs = []
+    path, _manifest = latest_complete(tmp_path, log=logs.append)
+    assert path.name == "ckpt-00000006"
+    assert logs and "ckpt-00000009" in logs[0]
+    assert state_digest(load_checkpoint(path)[0]) == early
+
+
+def test_all_checkpoints_corrupt_is_a_clean_error(tmp_path, warm_state):
+    _g, _cfg, st, _stats = warm_state
+    save_checkpoint(tmp_path, st, step=6, shards=2)
+    corrupt_checkpoint(tmp_path / "ckpt-00000006", "flip_byte")
+    with pytest.raises(CheckpointError, match="no COMPLETE checkpoint"):
+        latest_complete(tmp_path, log=lambda _m: None)
+
+
+def test_retention_prunes_oldest(tmp_path, warm_state):
+    _g, cfg, st, _stats = warm_state
+    for k in range(4):
+        save_checkpoint(tmp_path, st, step=6 + 3 * k, shards=1, keep=2)
+        st, _ = simulate(st, cfg, 3)
+    steps = [s for s, _ in list_checkpoint_steps(tmp_path)]
+    assert steps == [15, 12]
+    prune_checkpoints(tmp_path, keep=1)
+    assert [s for s, _ in list_checkpoint_steps(tmp_path)] == [15]
+
+
+# ------------------------------------------------- crash-resume parity
+def test_resume_bit_identity_composed_local(tmp_path):
+    """Interrupted-and-resumed == uninterrupted, bit for bit, on the
+    composed scenario×growth×stream×control cell (the mid-flight cursor
+    pins — fault_held, slot_lease, control_lvl, growth cursor — all
+    exercised through a disk round-trip)."""
+    from tpu_gossip.ckpt import CheckpointPolicy, host_stats, run_checkpointed
+    from tpu_gossip.control import compile_control
+    from tpu_gossip.core.state import clone_state
+    from tpu_gossip.faults import compile_scenario
+    from tpu_gossip.faults.scenario import scenario_from_dict
+    from tpu_gossip.growth import compile_growth, pad_graph_for_growth
+    from tpu_gossip.traffic import compile_stream
+
+    rounds = 14
+    g = small_graph(96)
+    g2, exists = pad_graph_for_growth(g, 128)
+    cfg = SwarmConfig(n_peers=128, msg_slots=8, fanout=2, mode="push_pull",
+                      churn_leave_prob=0.05, churn_join_prob=0.3,
+                      rewire_slots=2)
+    spec = scenario_from_dict({"name": "t", "phases": [
+        {"start": 2, "end": 8, "loss": 0.3, "delay": 0.4},
+    ]})
+    scen = compile_scenario(spec, n_peers=96, n_slots=128,
+                            total_rounds=rounds)
+    grow = compile_growth(n_initial=96, target=120, n_slots=128,
+                          joins_per_round=4, attach_m=2)
+    strm = compile_stream(rate=1.5, msg_slots=8, ttl=6,
+                          origin_rows=np.arange(96))
+    ctl = compile_control(target_ratio=0.9, fanout=2, lo=1, hi=2)
+    st = init_swarm(g2, cfg, origins=[0], key=jax.random.key(3),
+                    exists=exists)
+
+    fin_ref, stats_ref = simulate(clone_state(st), cfg, rounds, None,
+                                  "fused", scen, grow, strm, ctl)
+
+    policy = CheckpointPolicy(every=5, directory=str(tmp_path))
+
+    def seg_run(s, seg):
+        s, stats = simulate(s, cfg, seg, None, "fused", scen, grow, strm,
+                            ctl)
+        return s, host_stats(stats)
+
+    # phase 1: "crash" after the round-5 checkpoint lands (the driver
+    # never saves at its own horizon end, so 10 leaves only ckpt-5)
+    run_checkpointed(clone_state(st), 10, seg_run, policy=policy)
+    path, _m = latest_complete(tmp_path)
+    assert path.name == "ckpt-00000005"  # 10 == horizon end, not saved
+    loaded, prefix, _ = load_checkpoint(path)
+    # phase 2: resume to the full horizon
+    fin_res, sd = run_checkpointed(loaded, rounds, seg_run, policy=policy,
+                                   stats_prefix=prefix)
+    assert state_digest(fin_res) == state_digest(fin_ref)
+    ref_d = {f: np.asarray(getattr(stats_ref, f))
+             for f in stats_ref._fields}
+    for f, arr in ref_d.items():
+        if arr.dtype.kind in "biu":
+            np.testing.assert_array_equal(sd[f], arr, err_msg=f)
+
+
+def test_sharded_matching_save_local_load_bit_identity(tmp_path):
+    """The resharding contract's S'=1 leg at small n: a mesh-run
+    sharded-matching swarm checkpointed at S=8 files restores into the
+    LOCAL engine and finishes bit-identically to finishing on the mesh
+    — the s=1 layout-truth contract run in reverse."""
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.core.state import clone_state
+    from tpu_gossip.dist import (
+        make_mesh,
+        shard_matching_plan,
+        shard_swarm,
+        simulate_dist,
+    )
+
+    mesh = make_mesh()
+    dgraph, plan = matching_powerlaw_graph_sharded(
+        600, mesh.size, fanout=2, key=jax.random.key(0),
+    )
+    plan_m = shard_matching_plan(plan, mesh)
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    rows = (np.arange(1) // plan.n_per) * plan.n_blk + (
+        np.arange(1) % plan.n_per
+    )
+    st = init_swarm(dgraph.as_padded_graph(), cfg, key=jax.random.key(0),
+                    origins=rows, exists=dgraph.exists)
+    mid, _ = simulate_dist(shard_swarm(clone_state(st), mesh), cfg, plan_m,
+                           mesh, 4)
+    save_checkpoint(tmp_path, mid, step=4, shards=mesh.size)
+
+    fin_mesh, stats_mesh = simulate_dist(mid, cfg, plan_m, mesh, 4)
+    loaded, _, _ = load_checkpoint(tmp_path / "ckpt-00000004")
+    fin_local, stats_local = simulate(loaded, cfg, 4, plan)
+    assert state_digest(fin_local) == state_digest(fin_mesh)
+    assert stats_digest(stats_local) == stats_digest(stats_mesh)
+
+
+# ------------------------------------------------- fleet rank round-trip
+def test_fleet_stack_roundtrip_and_per_lane_recovery(tmp_path):
+    """stack_states → save → (a) the whole stack and (b) one lane solo
+    both load bit-exactly, and a recovered lane CONTINUES bit-identically
+    to its slice of the continued batch."""
+    from tpu_gossip.fleet.engine import simulate_fleet
+
+    g = small_graph(64)
+    cfg = SwarmConfig(n_peers=64, msg_slots=4, fanout=2)
+    lanes = [
+        init_swarm(g, cfg, origins=[k], key=jax.random.key(100 + k))
+        for k in range(3)
+    ]
+    batch = stack_states(lanes)
+    mid, _ = simulate_fleet(stack_states(lanes), cfg, 4)
+    save_checkpoint(tmp_path, mid, step=4, kind="fleet")
+    ck = tmp_path / "ckpt-00000004"
+
+    whole, _, manifest = load_checkpoint(ck)
+    assert manifest["lanes"] == 3
+    assert state_digest(whole) == state_digest(mid)
+    for k in range(3):
+        solo, _, _ = load_checkpoint(ck, lane=k)
+        assert state_digest(solo) == state_digest(lane_state(mid, k)), k
+
+    # continuation parity: the restored stack vs the live one, and one
+    # restored lane solo vs its batch slice
+    fin_live, _ = simulate_fleet(mid, cfg, 3)
+    fin_restored, _ = simulate_fleet(whole, cfg, 3)
+    assert state_digest(fin_restored) == state_digest(fin_live)
+    solo1, _, _ = load_checkpoint(ck, lane=1)
+    fin_solo, _ = simulate(solo1, cfg, 3)
+    assert state_digest(fin_solo) == state_digest(lane_state(fin_restored, 1))
+    del batch
+
+
+# ---------------------------------------------------- legacy + validation
+def test_both_legacy_formats_load_through_load_any(tmp_path):
+    """v1 positional and pre-plane named npz checkpoints load through the
+    new entry point — same states load_swarm produces, no manifest
+    required."""
+    from tests.unit.test_state import save_v1
+
+    g = small_graph(32)
+    st = init_swarm(g, SwarmConfig(n_peers=32, msg_slots=4), origins=[2])
+    v1 = tmp_path / "v1.npz"
+    save_v1(st, v1, per_peer_sir=True)
+    st_v1, stats, manifest = load_any(v1)
+    assert stats is None and manifest["format"] == "legacy-npz"
+    assert bool(jnp.array_equal(st_v1.seen, st.seen))
+
+    named = tmp_path / "named.npz"
+    save_swarm(named, st)
+    data = dict(np.load(named))
+    for newer in ("field_fault_held", "field_join_round",
+                  "field_admitted_by", "field_degree_credit",
+                  "field_slot_lease", "field_control_lvl",
+                  "field_pipe_buf"):
+        data.pop(newer)
+    np.savez(named, **data)
+    st_named, _, _ = load_any(named)
+    assert bool(jnp.array_equal(st_named.seen, st.seen))
+    assert not bool(st_named.fault_held.any())
+    assert str(st_named.join_round.dtype) == "int16"
+
+
+def test_load_swarm_names_the_broken_plane(tmp_path):
+    """A stale/foreign npz fails at load with the PLANE named — never as
+    a shape/dtype error inside jit."""
+    g = small_graph(32)
+    st = init_swarm(g, SwarmConfig(n_peers=32, msg_slots=4), origins=[2])
+    path = tmp_path / "ck.npz"
+
+    save_swarm(path, st)
+    data = dict(np.load(path))
+    data["field_seen"] = data["field_seen"].astype(np.float32)
+    np.savez(path, **data)
+    with pytest.raises(ValueError, match="'seen'.*dtype"):
+        load_swarm(path)
+
+    save_swarm(path, st)
+    data = dict(np.load(path))
+    data["field_alive"] = data["field_alive"][:16]
+    np.savez(path, **data)
+    with pytest.raises(ValueError, match="'alive'.*shape"):
+        load_swarm(path)
+
+
+def test_checkpoint_plane_validation_catches_foreign_manifest_dir(
+    tmp_path, warm_state
+):
+    """The same named-plane gate guards the manifest path: a shard file
+    whose plane dtype drifted (forged here by rewriting one shard AND
+    its digest) still fails with the plane's name."""
+    _g, _cfg, st, _stats = warm_state
+    save_checkpoint(tmp_path, st, step=6, shards=2)
+    ck = tmp_path / "ckpt-00000006"
+    name = "shard-00000-of-00002.npz"
+    arrays = dict(np.load(ck / name))
+    arrays["rows_seen"] = arrays["rows_seen"].astype(np.float32)
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    (ck / name).write_bytes(payload)
+    manifest = json.loads((ck / "MANIFEST.json").read_text())
+    import hashlib
+
+    manifest["files"][name] = {
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "bytes": len(payload),
+        "rows": manifest["files"][name]["rows"],
+    }
+    (ck / "MANIFEST.json").write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="'seen'"):
+        load_checkpoint(ck)
+
+
+# ------------------------------------------------------------ driver bits
+def test_next_cut_grids():
+    assert next_cut(0, 20, 5) == 5
+    assert next_cut(7, 20, 5) == 3
+    assert next_cut(18, 20, 5) == 2
+    assert next_cut(0, 20, 0) == 20
+    assert next_cut(4, 30, 6, 10) == 2  # min(6, 10) - 4
+    assert next_cut(6, 30, 6, 10) == 4  # next is 10
+
+
+# ------------------------------------------------------- CLI rejections
+@pytest.mark.parametrize("argv,needle", [
+    (["--rounds", "10", "--checkpoint-every", "3"], "--checkpoint-dir"),
+    (["--checkpoint-every", "3", "--checkpoint-dir", "d"], "FIXED horizon"),
+    (["--rounds", "10", "--keep", "2"], "--checkpoint-every"),
+    (["--rounds", "10", "--checkpoint-shards", "2"], "--checkpoint-every"),
+    (["--rounds", "10", "--checkpoint-every", "12",
+      "--checkpoint-dir", "d"], "below --rounds"),
+    (["--rounds", "12", "--checkpoint-every", "4", "--checkpoint-dir",
+      "d", "--shard", "--remat-every", "3"], "MULTIPLE of --remat-every"),
+])
+def test_cli_checkpoint_rejections(capsys, argv, needle):
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    rc = run_sim_main(["--peers", "64", "--slots", "4", "--quiet"] + argv)
+    assert rc == 2
+    assert needle in capsys.readouterr().err
+
+
+def test_cli_resume_rejects_empty_dir(tmp_path, capsys):
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    rc = run_sim_main(["resume", str(tmp_path)])
+    assert rc == 2
+    assert "no checkpoints" in capsys.readouterr().err
+
+
+def test_cli_checkpointed_run_resumes_bit_identically(tmp_path, capsys):
+    """End to end through the CLI: a checkpointing local run, the newest
+    checkpoint deleted (as if the crash hit mid-save), `run_sim resume`
+    — digests equal the uninterrupted run's."""
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    base = ["--peers", "64", "--rounds", "12", "--slots", "4",
+            "--fanout", "2", "--churn-leave", "0.05", "--churn-join",
+            "0.3", "--rewire-slots", "2", "--quiet", "--digest"]
+    assert run_sim_main(base) == 0
+    ref = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    d = tmp_path / "ck"
+    assert run_sim_main(base + ["--checkpoint-every", "4",
+                                "--checkpoint-dir", str(d)]) == 0
+    full = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert full["state_digest"] == ref["state_digest"]
+    assert full["stats_digest"] == ref["stats_digest"]
+
+    shutil.rmtree(d / "ckpt-00000008")
+    assert run_sim_main(["resume", str(d)]) == 0
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["state_digest"] == ref["state_digest"]
+    assert res["stats_digest"] == ref["stats_digest"]
+
+
+def test_cli_remat_run_resumes_bit_identically(tmp_path, capsys):
+    """The local remat epoch loop composes with checkpointing: fold
+    boundaries and checkpoint boundaries interleave, and a resumed run
+    (including an epoch-boundary checkpoint that must replay its fold)
+    matches the uninterrupted digests."""
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    base = ["--peers", "64", "--rounds", "12", "--slots", "4",
+            "--fanout", "2", "--churn-leave", "0.1", "--churn-join",
+            "0.4", "--rewire-slots", "2", "--remat-every", "3",
+            "--quiet", "--digest"]
+    d = tmp_path / "ck"
+    assert run_sim_main(base + ["--checkpoint-every", "6",
+                                "--checkpoint-dir", str(d)]) == 0
+    full = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    assert run_sim_main(["resume", str(d)]) == 0
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["state_digest"] == full["state_digest"]
+    assert res["stats_digest"] == full["stats_digest"]
+
+
+@pytest.mark.slow
+def test_sigkill_mid_horizon_resume(tmp_path):
+    """The real thing: a checkpointing subprocess SIGKILLed mid-horizon,
+    resumed in a fresh process, digest-equal to an uninterrupted run.
+    (The recovery-smoke CI job runs this same drill on the 8-CPU mesh
+    against the sharded matching engine.)"""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+    import time
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    d = tmp_path / "ck"
+    base = [_sys.executable, "-m", "tpu_gossip.cli.run_sim", "--peers",
+            "96", "--rounds", "40", "--slots", "4", "--fanout", "2",
+            "--quiet", "--digest"]
+    ref = subprocess.run(base, capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert ref.returncode == 0, ref.stderr
+    want = json.loads(ref.stdout.strip().splitlines()[-1])
+
+    proc = subprocess.Popen(
+        base + ["--checkpoint-every", "10", "--checkpoint-dir", str(d)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if list_checkpoint_steps(d):
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.2)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    assert list_checkpoint_steps(d), "no checkpoint landed before the kill"
+
+    res = subprocess.run(
+        [_sys.executable, "-m", "tpu_gossip.cli.run_sim", "resume", str(d)],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr
+    got = json.loads(res.stdout.strip().splitlines()[-1])
+    assert got["state_digest"] == want["state_digest"]
+    assert got["stats_digest"] == want["stats_digest"]

@@ -140,7 +140,9 @@ def test_sir_recovery_is_per_slot(graph):
     assert np.asarray(fin.recovered)[:, 0].sum() > 0.9 * N
     # inject a SECOND rumor (slot 1) after the first epidemic is over
     seen = fin.seen.at[7, 1].set(True)
-    infected = fin.infected_round.at[7, 1].set(fin.round)
+    infected = fin.infected_round.at[7, 1].set(
+        fin.round.astype(fin.infected_round.dtype)
+    )
     st2 = dataclasses.replace(fin, seen=seen, infected_round=infected)
     fin2, _ = simulate(st2, cfg, 30)
     cov1 = np.asarray(fin2.seen)[:, 1].mean()

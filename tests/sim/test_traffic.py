@@ -186,8 +186,8 @@ def _raw_injection_rows(stream, st, key, rnd=1):
     """Call the injection stage directly on a virgin swarm and read the
     rows its arrivals landed on (the per-law distribution probe)."""
     seen = jnp.zeros_like(st.seen)
-    ir = jnp.full(st.seen.shape, -1, dtype=jnp.int32)
-    lease = jnp.full((st.seen.shape[1],), -1, dtype=jnp.int32)
+    ir = jnp.full(st.seen.shape, -1, dtype=jnp.int16)
+    lease = jnp.full((st.seen.shape[1],), -1, dtype=jnp.int16)
     seen2, _, _, telem = apply_stream(
         stream, key, jnp.asarray(rnd, jnp.int32), jnp.zeros((), jnp.int32),
         seen=seen, infected_round=ir, slot_lease=lease,
@@ -226,8 +226,8 @@ def test_degree_origin_law_favors_hubs():
     counts = np.zeros(N)
     for s in range(6):
         seen = jnp.zeros_like(st.seen)
-        ir = jnp.full(st.seen.shape, -1, dtype=jnp.int32)
-        lease = jnp.full((64,), -1, dtype=jnp.int32)
+        ir = jnp.full(st.seen.shape, -1, dtype=jnp.int16)
+        lease = jnp.full((64,), -1, dtype=jnp.int16)
         seen2, _, _, _ = apply_stream(
             strm, jax.random.key(100 + s), jnp.asarray(1, jnp.int32),
             jnp.zeros((), jnp.int32), seen=seen, infected_round=ir,

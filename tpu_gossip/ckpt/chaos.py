@@ -1,0 +1,85 @@
+"""Durability fault injection: corrupt a checkpoint the way hardware does.
+
+The recovery contract is only as strong as the failure modes it is
+tested against. This module is the test harness's (and the
+recovery-smoke CI job's) way of manufacturing each mode
+deterministically against a REAL checkpoint directory:
+
+- ``truncate_shard`` — a shard file loses its tail (power loss between
+  write and fsync on a weaker store, or a copy cut short).
+- ``flip_byte``     — one byte flips mid-file (bit rot; a bad sector
+  remap; a buggy transfer).
+- ``drop_manifest`` — the manifest vanishes (the torn-write signature:
+  a crash before the final rename leaves exactly this state).
+- ``drop_shard``    — a whole shard file vanishes mid-write (crash
+  between two shard renames).
+
+Every mode must be DETECTED at recovery (``verify_checkpoint`` fails
+with a named reason) and ROLLED BACK past (``latest_complete`` selects
+the previous complete checkpoint) — never loaded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from tpu_gossip.ckpt.store import MANIFEST_NAME, CheckpointError
+
+__all__ = ["CORRUPTION_MODES", "corrupt_checkpoint"]
+
+CORRUPTION_MODES = (
+    "truncate_shard", "flip_byte", "drop_manifest", "drop_shard",
+)
+
+
+def _payload_files(ckdir: Path) -> list[Path]:
+    manifest = json.loads((ckdir / MANIFEST_NAME).read_text())
+    names = sorted(manifest.get("files", {}))
+    return [ckdir / n for n in names]
+
+
+def corrupt_checkpoint(
+    ckdir, mode: str, *, index: int = 0, seed: int = 0
+) -> Path:
+    """Apply one corruption ``mode`` to the checkpoint at ``ckdir``.
+
+    ``index`` picks the payload file (manifest order) for the file-level
+    modes; ``seed`` picks the flipped byte's offset deterministically.
+    Returns the path that was damaged.
+    """
+    ckdir = Path(ckdir)
+    if mode not in CORRUPTION_MODES:
+        raise ValueError(
+            f"unknown corruption mode {mode!r}; choose from "
+            f"{CORRUPTION_MODES}"
+        )
+    if mode == "drop_manifest":
+        target = ckdir / MANIFEST_NAME
+        if not target.is_file():
+            raise CheckpointError(f"{ckdir} has no manifest to drop")
+        target.unlink()
+        return target
+    files = _payload_files(ckdir)
+    if not files:
+        raise CheckpointError(f"{ckdir} lists no payload files")
+    target = files[index % len(files)]
+    if mode == "drop_shard":
+        target.unlink()
+        return target
+    payload = bytearray(target.read_bytes())
+    if not payload:
+        raise CheckpointError(f"{target} is empty — nothing to corrupt")
+    if mode == "truncate_shard":
+        del payload[len(payload) // 2:]
+    else:  # flip_byte
+        # deterministic offset from the seed; avoid offset 0 so the npz
+        # magic stays plausible and the DIGEST, not a parser error, is
+        # what must catch it
+        offset = 1 + (seed * 2654435761) % (len(payload) - 1)
+        payload[offset] ^= 0x40
+    tmp = target.with_name(f".tmp-chaos-{target.name}")
+    tmp.write_bytes(bytes(payload))
+    os.replace(tmp, target)
+    return target

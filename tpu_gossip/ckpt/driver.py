@@ -1,0 +1,153 @@
+"""Segmented fixed-horizon runner: periodic checkpoints OUTSIDE the jit.
+
+The engines' jitted loop entries (``simulate`` / ``simulate_dist`` /
+``simulate_fleet``) scan a whole horizon on device; a checkpoint cannot
+land inside that scan without breaking donation and the bit-identity
+contract. But splitting the scan at a round boundary IS bit-identical —
+every round is a pure function of the carried state, which is exactly
+what the remat epoch loops have relied on since PR 1 and the mid-flight
+cursor pins (``fault_held``, ``slot_lease``, ``control_lvl``,
+``pipe_buf``, the growth cursor) guarantee for every composed plane. So
+the driver runs the horizon as segments cut at ``--checkpoint-every``
+boundaries, saves the state + the stats-so-far between segments (reads
+happen BEFORE the next segment donates the buffers), and concatenates
+per-segment stats into the one trajectory the summary reads — a resumed
+run therefore produces the identical final state and identical integer
+stats, crash or no crash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = [
+    "CheckpointPolicy",
+    "next_cut",
+    "host_stats",
+    "concat_stats",
+    "run_checkpointed",
+]
+
+
+@dataclasses.dataclass
+class CheckpointPolicy:
+    """The CLI's settled checkpointing config, threaded to every engine
+    path. ``shards`` is the FILE-level shard count (a storage choice —
+    see the resharding contract in ckpt/store.py); ``run_config`` lands
+    in each manifest for ``run_sim resume`` to rebuild from."""
+
+    every: int
+    directory: str
+    keep: int = 0
+    shards: int = 1
+    kind: str = "run"
+    run_config: dict | None = None
+
+
+def next_cut(cur: int, total: int, *periods: int) -> int:
+    """Rounds from ``cur`` to the next boundary: the horizon end or any
+    period's next multiple (0/None periods ignored)."""
+    nxt = total
+    for p in periods:
+        if p:
+            nxt = min(nxt, (cur // p + 1) * p)
+    return nxt - cur
+
+
+def host_stats(stats, ici=None) -> dict:
+    """One segment's stats as host arrays, keyed by field name; an
+    active transport's analytic ICI counters ride along under the
+    ``ici__`` prefix so a resumed run's byte accounting stays exact."""
+    out = {f: np.asarray(getattr(stats, f)) for f in stats._fields}
+    if ici is not None:
+        for f in ici._fields:
+            out[f"ici__{f}"] = np.asarray(getattr(ici, f))
+    return out
+
+
+def concat_stats(parts: list[dict], round_axis: int = 0) -> dict:
+    """Concatenate per-segment stats dicts along the round axis (axis 1
+    for fleet-batched stats). Key sets must agree — a prefix saved by a
+    run with a different stats schema is a config error, not a silent
+    truncation."""
+    if not parts:
+        return {}
+    keys = set(parts[0])
+    for p in parts[1:]:
+        if set(p) != keys:
+            raise ValueError(
+                "stats segments disagree on fields: "
+                f"{sorted(keys ^ set(p))} — the checkpoint was written by "
+                "an incompatible run configuration"
+            )
+    return {
+        k: np.concatenate([p[k] for p in parts], axis=round_axis)
+        for k in sorted(keys)
+    }
+
+
+def run_checkpointed(
+    state,
+    total_rounds: int,
+    run_segment,
+    *,
+    policy: CheckpointPolicy | None = None,
+    stats_prefix: dict | None = None,
+    round_axis: int = 0,
+    fold_every: int = 0,
+    fold=None,
+    log=None,
+):
+    """Drive ``state`` to ``total_rounds`` in checkpoint-boundary segments.
+
+    ``run_segment(state, seg) -> (state, stats_dict)`` runs ``seg``
+    rounds through the engine's jitted loop and returns HOST stats
+    (:func:`host_stats`). ``fold`` (with ``fold_every``) is the remat
+    epoch hook: called as ``fold(state) -> state`` at every
+    ``fold_every`` multiple strictly inside the horizon, AFTER any
+    coinciding checkpoint save — so a checkpoint at an epoch boundary
+    holds the PRE-fold state and resume replays the fold
+    deterministically (the shard engines' re-partition draws its seed
+    from the fold index, which the round cursor determines).
+
+    Returns ``(state, stats_dict)`` with the stats prefix (a resumed
+    run's pre-crash trajectory) concatenated in front.
+    """
+    parts: list[dict] = []
+    if stats_prefix is not None:
+        parts.append(dict(stats_prefix))
+    cur = _round_of(state)
+    every = policy.every if policy is not None else 0
+    # a resumed run landing ON a fold boundary replays the fold first —
+    # the matching uninterrupted run folded right after writing the
+    # checkpoint this state came from
+    if fold is not None and fold_every and cur and cur % fold_every == 0 \
+            and cur < total_rounds and stats_prefix is not None:
+        state = fold(state)
+    while cur < total_rounds:
+        seg = next_cut(cur, total_rounds, every, fold_every)
+        state, seg_stats = run_segment(state, seg)
+        parts.append(seg_stats)
+        cur += seg
+        if policy is not None and every and cur % every == 0 \
+                and cur < total_rounds:
+            from tpu_gossip.ckpt.store import save_checkpoint
+
+            save_checkpoint(
+                policy.directory, state, step=cur,
+                shards=policy.shards,
+                stats=concat_stats(parts, round_axis),
+                run_config=policy.run_config, kind=policy.kind,
+                keep=policy.keep, log=log,
+            )
+        if fold is not None and fold_every and cur % fold_every == 0 \
+                and cur < total_rounds:
+            state = fold(state)
+    return state, concat_stats(parts, round_axis)
+
+
+def _round_of(state) -> int:
+    r = np.asarray(state.round)
+    return int(r if r.ndim == 0 else r.reshape(-1)[0])

@@ -1,0 +1,534 @@
+"""The on-disk checkpoint format: sharded atomic writes + a manifest gate.
+
+One checkpoint is one directory::
+
+    <dir>/ckpt-00000040/
+        shard-00000-of-00008.npz   # rows [lo, hi) of every (N, ·) plane
+        ...                        # + that range's CSR slice
+        global.npz                 # (M,)/scalar planes, the PRNG key,
+                                   # the CSR capacity tail   (kind "run")
+        lane-00003-of-00016.npz    # one lane's FULL solo state (kind
+                                   # "fleet" — per-lane recovery is just
+                                   # loading one file)
+        stats.npz                  # the per-round stats accumulated so
+                                   # far (the resumed trajectory's prefix)
+        MANIFEST.json              # written LAST: format version, round
+                                   # cursor, per-file sha256 digests,
+                                   # PLANES-declared dtypes/shapes, the
+                                   # run config resume rebuilds from
+
+Atomicity is rename-based: every file is written to a temp name in the
+same directory, fsynced, then ``os.replace``d into place; the manifest
+lands LAST (after a directory fsync), so a crash mid-write leaves a
+directory WITHOUT a complete manifest — by definition torn, skipped at
+recovery. Integrity is digest-based: the manifest records each file's
+sha256; a truncated shard, a flipped byte or a swapped file fails
+verification and the recovery scan rolls back to the previous complete
+checkpoint with a logged reason.
+
+Resharding contract: the S shard files are row SLICES of the one global
+state layout (the layout itself is set by the run's plan at build time
+and recorded in the manifest), so the file-level shard count is a
+storage choice, not a run constraint — an S-shard checkpoint
+concatenates into the global state and restores into S′ shards for any
+compatible run layout, including S′=1: the sharded-matching layout's
+s=1 layout-truth contract run in reverse (sharded save → local load is
+bit-identical, conformance-tested at small n in tests/sim/test_ckpt.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import shutil
+from pathlib import Path
+
+import numpy as np
+
+__all__ = [
+    "CheckpointError",
+    "MANIFEST_NAME",
+    "FORMAT_VERSION",
+    "checkpoint_name",
+    "save_checkpoint",
+    "verify_checkpoint",
+    "list_checkpoint_steps",
+    "latest_complete",
+    "load_checkpoint",
+    "load_any",
+    "prune_checkpoints",
+]
+
+MANIFEST_NAME = "MANIFEST.json"
+FORMAT_VERSION = 2
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
+
+# planes stored per shard file are exactly the registry's (N, ·)-leading
+# shapes; the CSR pair is row-sliced specially; everything else rides
+# global.npz. Derived from PLANES so a new plane cannot silently miss the
+# checkpoint format (tests pin the partition).
+_CSR_PLANES = ("row_ptr", "col_idx")
+
+
+class CheckpointError(Exception):
+    """A torn, corrupt, or structurally foreign checkpoint."""
+
+
+def checkpoint_name(step: int) -> str:
+    return f"ckpt-{step:08d}"
+
+
+def _row_planes():
+    from tpu_gossip.core.state import PLANES
+
+    return tuple(
+        p.name for p in PLANES
+        if p.shape.startswith("(N") and p.name not in _CSR_PLANES
+    )
+
+
+def _global_planes():
+    from tpu_gossip.core.state import PLANES
+
+    return tuple(
+        p.name for p in PLANES
+        if not p.shape.startswith("(N") and p.name not in _CSR_PLANES
+    )
+
+
+def _key_data(leaf):
+    import jax
+
+    return np.asarray(jax.random.key_data(leaf))
+
+
+def _npz_bytes(arrays: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _atomic_write(path: Path, payload: bytes) -> dict:
+    """temp-file + fsync + atomic rename; returns the manifest file entry."""
+    tmp = path.with_name(f".tmp-{path.name}.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return {
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "bytes": len(payload),
+    }
+
+
+def _fsync_dir(path: Path) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover — exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _state_to_host(state) -> dict:
+    """Every leaf as a host array (PRNG keys via their raw key data)."""
+    out = {}
+    for f in dataclasses.fields(type(state)):
+        leaf = getattr(state, f.name)
+        if _is_key(leaf):
+            out[f.name] = _key_data(leaf)
+        else:
+            out[f.name] = np.asarray(leaf)
+    return out
+
+
+def _is_key(leaf) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    return hasattr(leaf, "dtype") and jnp.issubdtype(
+        leaf.dtype, jax.dtypes.prng_key
+    )
+
+
+def save_checkpoint(
+    directory,
+    state,
+    *,
+    step: int,
+    shards: int = 1,
+    stats: dict | None = None,
+    run_config: dict | None = None,
+    kind: str = "run",
+    keep: int = 0,
+    log=None,
+) -> Path:
+    """Write one complete checkpoint of ``state`` at round ``step``.
+
+    ``kind="run"`` shards the peer axis over ``shards`` files (each file
+    carries rows [lo, hi) of every (N, ·) plane plus that range's CSR
+    slice). ``kind="fleet"`` takes a :func:`stack_states` batch and
+    writes one file per LANE — each file is a complete solo state, so
+    per-lane recovery is loading one file. ``stats`` is a dict of host
+    arrays (the per-round trajectory so far); ``run_config`` lands in
+    the manifest verbatim (what ``run_sim resume`` rebuilds from).
+    ``keep`` > 0 prunes all but the newest ``keep`` checkpoints AFTER
+    the new manifest is durable.
+    """
+    directory = Path(directory)
+    ckdir = directory / checkpoint_name(step)
+    ckdir.mkdir(parents=True, exist_ok=True)
+    for leftover in ckdir.glob(".tmp-*"):
+        leftover.unlink()
+
+    files: dict[str, dict] = {}
+    manifest: dict = {
+        "format": FORMAT_VERSION,
+        "kind": kind,
+        "round": int(step),
+        "files": files,
+    }
+
+    if kind == "fleet":
+        lead = np.asarray(state.round).shape
+        if len(lead) != 1:
+            raise CheckpointError(
+                "kind='fleet' expects a stack_states batch (every leaf "
+                f"with a leading lane axis); round has shape {lead}"
+            )
+        lanes = int(lead[0])
+        manifest["lanes"] = lanes
+        manifest["n_peers"] = int(state.seen.shape[1])
+        manifest["msg_slots"] = int(state.seen.shape[2])
+        planes = {}
+        for f in dataclasses.fields(type(state)):
+            leaf = getattr(state, f.name)
+            if _is_key(leaf):
+                planes[f.name] = {"dtype": "key", "shape": []}
+            else:
+                # per-LANE dtype/shape: the lane axis is a storage
+                # dimension, each file holds one solo state
+                planes[f.name] = {
+                    "dtype": str(leaf.dtype),
+                    "shape": list(leaf.shape[1:]),
+                }
+        manifest["planes"] = planes
+        for k in range(lanes):
+            lane_arrays = {}
+            for f in dataclasses.fields(type(state)):
+                leaf = getattr(state, f.name)
+                if _is_key(leaf):
+                    lane_arrays[f"prngkey_{f.name}"] = _key_data(leaf[k])
+                else:
+                    lane_arrays[f"field_{f.name}"] = np.asarray(leaf[k])
+            name = f"lane-{k:05d}-of-{lanes:05d}.npz"
+            entry = _atomic_write(ckdir / name, _npz_bytes(lane_arrays))
+            entry["lane"] = k
+            files[name] = entry
+    elif kind == "run":
+        host = _state_to_host(state)
+        n = host["alive"].shape[0]
+        manifest["n_peers"] = n
+        manifest["msg_slots"] = int(host["seen"].shape[1])
+        manifest["shards"] = int(shards)
+        manifest["planes"] = {
+            name: {"dtype": str(arr.dtype) if name != "rng" else "key",
+                   "shape": list(arr.shape)}
+            for name, arr in host.items()
+        }
+        rp = host["row_ptr"]
+        e_real = int(rp[-1])
+        bounds = np.linspace(0, n, int(shards) + 1).astype(int)
+        row_planes = [p for p in _row_planes() if p in host]
+        for s in range(int(shards)):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            arrays = {f"rows_{p}": host[p][lo:hi] for p in row_planes}
+            # the CSR slice: absolute row_ptr entries [lo, hi] and the
+            # real edges they span — stored verbatim, so concatenation
+            # reproduces the exact bytes (the capacity tail past
+            # row_ptr[-1] rides global.npz)
+            arrays["rows_row_ptr"] = rp[lo:hi + 1]
+            arrays["rows_col_idx"] = host["col_idx"][int(rp[lo]):int(rp[hi])]
+            name = f"shard-{s:05d}-of-{int(shards):05d}.npz"
+            entry = _atomic_write(ckdir / name, _npz_bytes(arrays))
+            entry["rows"] = [lo, hi]
+            files[name] = entry
+        gl = {f"field_{p}": host[p] for p in _global_planes() if p != "rng"}
+        gl["prngkey_rng"] = host["rng"]
+        gl["col_tail"] = host["col_idx"][e_real:]
+        files["global.npz"] = _atomic_write(ckdir / "global.npz",
+                                            _npz_bytes(gl))
+    else:
+        raise CheckpointError(f"unknown checkpoint kind {kind!r}")
+
+    if stats is not None:
+        files["stats.npz"] = _atomic_write(
+            ckdir / "stats.npz",
+            _npz_bytes({k: np.asarray(v) for k, v in stats.items()}),
+        )
+    if run_config is not None:
+        manifest["run"] = run_config
+
+    # every payload is durable and digest-recorded — land the manifest
+    # LAST so its presence IS the completeness marker
+    _fsync_dir(ckdir)
+    _atomic_write(ckdir / MANIFEST_NAME,
+                  json.dumps(manifest, indent=1).encode())
+    _fsync_dir(ckdir)
+    _fsync_dir(directory)
+    if log is not None:
+        log(f"checkpoint: wrote {ckdir.name} "
+            f"({sum(e['bytes'] for e in files.values())} bytes, "
+            f"{len(files)} files)")
+    if keep > 0:
+        prune_checkpoints(directory, keep=keep, log=log)
+    return ckdir
+
+
+def list_checkpoint_steps(directory) -> list[tuple[int, Path]]:
+    """All ckpt-* entries under ``directory``, NEWEST first (no
+    verification — that is :func:`latest_complete`'s job)."""
+    directory = Path(directory)
+    out = []
+    if not directory.is_dir():
+        return out
+    for child in directory.iterdir():
+        m = _CKPT_RE.match(child.name)
+        if m and child.is_dir():
+            out.append((int(m.group(1)), child))
+    out.sort(key=lambda t: t[0], reverse=True)
+    return out
+
+
+def verify_checkpoint(path) -> dict:
+    """Return the manifest iff the checkpoint is complete and digest-clean;
+    raise :class:`CheckpointError` naming the failure otherwise."""
+    path = Path(path)
+    mpath = path / MANIFEST_NAME
+    if not mpath.is_file():
+        raise CheckpointError(
+            f"{path.name}: no {MANIFEST_NAME} — torn write (the manifest "
+            "lands last; a crash mid-save leaves none)"
+        )
+    try:
+        manifest = json.loads(mpath.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointError(
+            f"{path.name}: unreadable manifest ({e}) — torn write"
+        ) from e
+    if manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{path.name}: manifest format {manifest.get('format')!r} "
+            f"(this build reads {FORMAT_VERSION})"
+        )
+    for name, entry in manifest.get("files", {}).items():
+        fpath = path / name
+        if not fpath.is_file():
+            raise CheckpointError(
+                f"{path.name}: shard file {name} missing — dropped mid-write"
+            )
+        payload = fpath.read_bytes()
+        if len(payload) != entry["bytes"]:
+            raise CheckpointError(
+                f"{path.name}: {name} holds {len(payload)} bytes, manifest "
+                f"says {entry['bytes']} — truncated"
+            )
+        if hashlib.sha256(payload).hexdigest() != entry["sha256"]:
+            raise CheckpointError(
+                f"{path.name}: {name} sha256 mismatch — corrupted"
+            )
+    return manifest
+
+
+def latest_complete(directory, log=None) -> tuple[Path, dict]:
+    """Newest complete checkpoint under ``directory``, rolling back past
+    torn/corrupt ones with a logged reason per skip."""
+    steps = list_checkpoint_steps(directory)
+    if not steps:
+        raise CheckpointError(f"no checkpoints under {directory}")
+    for _step, path in steps:
+        try:
+            manifest = verify_checkpoint(path)
+        except CheckpointError as e:
+            if log is not None:
+                log(f"checkpoint: rolling back past {path.name}: {e}")
+            continue
+        return path, manifest
+    raise CheckpointError(
+        f"no COMPLETE checkpoint under {directory} — every candidate was "
+        "torn or corrupt (reasons logged above)"
+    )
+
+
+def _load_npz(path: Path) -> dict:
+    with np.load(path) as data:
+        return {k: data[k] for k in data.files}
+
+
+def load_checkpoint(path, *, lane: int | None = None,
+                    manifest: dict | None = None):
+    """Load one verified checkpoint directory.
+
+    Returns ``(state, stats, manifest)`` — ``state`` a
+    :class:`~tpu_gossip.core.state.SwarmState` (the concatenated global
+    layout for kind "run"; for kind "fleet" the re-stacked batch, or
+    lane ``lane`` as a SOLO state when given), ``stats`` the stored
+    trajectory prefix as a dict of host arrays (None if the checkpoint
+    carries none). Digests are verified before any bytes are trusted;
+    pass the ``manifest`` :func:`latest_complete` already verified to
+    skip the second full read+hash pass (recovery of a multi-GB
+    checkpoint should not pay its I/O twice). Restored planes pass the
+    PLANES dtype/shape validation (core.state.validate_state_planes),
+    so a stale or foreign file fails HERE with a named plane, not
+    inside jit.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_gossip.core.state import (
+        SwarmState,
+        cast_to_declared,
+        stack_states,
+        validate_state_planes,
+    )
+
+    path = Path(path)
+    if manifest is None:
+        manifest = verify_checkpoint(path)
+    kind = manifest.get("kind", "run")
+
+    def build_solo(arrays: dict, source: str) -> SwarmState:
+        kwargs = {}
+        for f in dataclasses.fields(SwarmState):
+            if f"prngkey_{f.name}" in arrays:
+                kwargs[f.name] = jax.random.wrap_key_data(
+                    jnp.asarray(arrays[f"prngkey_{f.name}"])
+                )
+            elif f"field_{f.name}" in arrays:
+                kwargs[f.name] = jnp.asarray(arrays[f"field_{f.name}"])
+            else:
+                raise CheckpointError(
+                    f"{source}: plane {f.name!r} missing from the "
+                    "checkpoint — foreign or pre-format file"
+                )
+        kwargs = cast_to_declared(kwargs)
+        state = SwarmState(**kwargs)
+        validate_state_planes(state, source=source)
+        return state
+
+    if kind == "fleet":
+        lanes = int(manifest["lanes"])
+        lane_files = sorted(
+            (e["lane"], name) for name, e in manifest["files"].items()
+            if "lane" in e
+        )
+        if len(lane_files) != lanes:
+            raise CheckpointError(
+                f"{path.name}: manifest declares {lanes} lanes but lists "
+                f"{len(lane_files)} lane files"
+            )
+        if lane is not None:
+            if not (0 <= lane < lanes):
+                raise CheckpointError(
+                    f"{path.name}: lane {lane} outside [0, {lanes})"
+                )
+            name = dict((k, n) for k, n in lane_files)[lane]
+            state = build_solo(_load_npz(path / name), f"{path.name}/{name}")
+        else:
+            state = stack_states([
+                build_solo(_load_npz(path / name), f"{path.name}/{name}")
+                for _k, name in lane_files
+            ])
+    else:
+        shard_files = sorted(
+            (e["rows"][0], e["rows"][1], name)
+            for name, e in manifest["files"].items() if "rows" in e
+        )
+        if not shard_files:
+            raise CheckpointError(f"{path.name}: manifest lists no shard files")
+        gl = _load_npz(path / "global.npz")
+        parts = [_load_npz(path / name) for _lo, _hi, name in shard_files]
+        covered = 0
+        for (lo, hi, name) in shard_files:
+            if lo != covered:
+                raise CheckpointError(
+                    f"{path.name}: shard rows are not contiguous at {name} "
+                    f"(expected [{covered}, ...), got [{lo}, {hi}))"
+                )
+            covered = hi
+        if covered != int(manifest["n_peers"]):
+            raise CheckpointError(
+                f"{path.name}: shard files cover {covered} rows, manifest "
+                f"declares n_peers={manifest['n_peers']}"
+            )
+        arrays = {}
+        for p in _row_planes():
+            arrays[f"field_{p}"] = np.concatenate(
+                [part[f"rows_{p}"] for part in parts], axis=0
+            )
+        # CSR reassembly: absolute row_ptr slices overlap by one entry at
+        # each boundary; the capacity tail (past row_ptr[-1]) comes back
+        # from global.npz — stored verbatim, so the reassembled pair is
+        # byte-identical to the saved one
+        rp_parts = [parts[0]["rows_row_ptr"]] + [
+            part["rows_row_ptr"][1:] for part in parts[1:]
+        ]
+        arrays["field_row_ptr"] = np.concatenate(rp_parts, axis=0)
+        arrays["field_col_idx"] = np.concatenate(
+            [part["rows_col_idx"] for part in parts] + [gl["col_tail"]],
+            axis=0,
+        )
+        for key, val in gl.items():
+            if key == "col_tail":
+                continue
+            arrays[key] = val
+        state = build_solo(arrays, path.name)
+
+    stats = None
+    if "stats.npz" in manifest.get("files", {}):
+        stats = _load_npz(path / "stats.npz")
+    return state, stats, manifest
+
+
+def load_any(path, *, lane: int | None = None):
+    """Load a checkpoint from either world: a manifest directory (the
+    durable format) or a bare ``.npz`` (BOTH legacy flat formats — the
+    v1 positional layout and the pre-plane named layout — via
+    ``core.state.load_swarm``, which applies the same declared-width
+    casts and plane validation). Returns ``(state, stats, manifest)``;
+    legacy files carry no stats prefix and a synthetic manifest."""
+    path = Path(path)
+    if path.is_dir():
+        if (path / MANIFEST_NAME).is_file() or _CKPT_RE.match(path.name):
+            return load_checkpoint(path, lane=lane)
+        ck, _manifest = latest_complete(path)
+        return load_checkpoint(ck, lane=lane)
+    from tpu_gossip.core.state import load_swarm
+
+    state = load_swarm(path)
+    return state, None, {
+        "format": "legacy-npz", "kind": "run",
+        "round": int(np.asarray(state.round)),
+    }
+
+
+def prune_checkpoints(directory, *, keep: int, log=None) -> list[Path]:
+    """Delete all but the newest ``keep`` checkpoint directories (torn
+    ones older than the kept set included — they are unusable by
+    definition). Returns the deleted paths."""
+    if keep <= 0:
+        return []
+    steps = list_checkpoint_steps(directory)
+    doomed = [path for _step, path in steps[keep:]]
+    for path in doomed:
+        shutil.rmtree(path, ignore_errors=True)
+        if log is not None:
+            log(f"checkpoint: pruned {path.name} (keep={keep})")
+    return doomed

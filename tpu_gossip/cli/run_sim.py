@@ -242,6 +242,46 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quiet", action="store_true", help="summary line only, no per-round JSONL")
     p.add_argument("--checkpoint", type=str, default="", help="save final SwarmState to this .npz")
     p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="K",
+        help="durable periodic checkpointing (tpu_gossip/ckpt/, docs/"
+        "checkpointing.md): every K rounds, write a sharded atomic "
+        "checkpoint (temp-file + rename per shard, manifest with sha256 "
+        "digests landing LAST) into --checkpoint-dir. The horizon runs "
+        "as K-round segments OUTSIDE the jitted loop — bit-identical to "
+        "the unsegmented run — and `run_sim resume D` continues from the "
+        "newest complete checkpoint with the identical final state and "
+        "integer-stat trajectory. Needs a fixed --rounds horizon; with "
+        "--shard --remat-every R, K must be a multiple of R "
+        "(checkpoints land at epoch boundaries, pre-fold)",
+    )
+    p.add_argument(
+        "--checkpoint-dir", type=str, default="", metavar="D",
+        help="directory the periodic checkpoints land in (one "
+        "ckpt-<round> subdirectory each)",
+    )
+    p.add_argument(
+        "--keep", type=int, default=0, metavar="N",
+        help="retention: prune all but the newest N complete checkpoints "
+        "after each save (0 = keep every checkpoint)",
+    )
+    p.add_argument(
+        "--checkpoint-shards", type=int, default=0, metavar="S",
+        help="file-level shard count per checkpoint (each shard file "
+        "carries its row range of every peer plane + that range's CSR "
+        "slice). A storage choice, not a run constraint — any S loads "
+        "into any compatible run layout, including S'=1 (docs/"
+        "checkpointing.md resharding contract). Default: the mesh size "
+        "under --shard, else 1",
+    )
+    p.add_argument(
+        "--digest", action="store_true",
+        help="add state_digest/stats_digest (sha256 over the final state "
+        "and the integer stat trajectory) to a fixed-horizon summary — "
+        "the fields the recovery-smoke CI compares between a SIGKILLed-"
+        "then-resumed run and an uninterrupted one. Implied by "
+        "--checkpoint-every and by resume",
+    )
+    p.add_argument(
         "--profile", type=str, default="",
         help="record a jax.profiler device trace of the run into this directory "
         "(view with TensorBoard/xprof; SURVEY.md §5.1)",
@@ -256,8 +296,23 @@ def main(argv: list[str] | None = None) -> int:
         # Monte Carlo certification run (tpu_gossip/fleet/,
         # docs/fleet_campaigns.md) instead of one swarm
         return _main_fleet(argv[1:])
+    if argv and argv[0] == "resume":
+        # crash recovery (tpu_gossip/ckpt/, docs/checkpointing.md):
+        # pick the newest COMPLETE checkpoint under D — rolling back
+        # past torn/corrupt ones with a logged reason — rebuild the run
+        # from the manifest's recorded config, and continue to the
+        # original horizon bit for bit
+        return _main_resume(argv[1:])
     args = build_parser().parse_args(argv)
+    return _run(args)
 
+
+def _run(args, resume=None) -> int:
+    """The single-swarm run body — parse-validated ``args`` in, exit
+    code out. ``resume`` (set only by ``run_sim resume``) carries
+    ``(state, stats_prefix, manifest)``: the engine paths swap the
+    checkpointed state in after building plans/layouts deterministically
+    from the recorded args, and seed their stats with the prefix."""
     import jax
 
     from tpu_gossip.core import topology
@@ -319,6 +374,10 @@ def main(argv: list[str] | None = None) -> int:
     if control_err:
         print(control_err, file=sys.stderr)
         return 2
+    ckpt_err = _validate_ckpt(args)
+    if ckpt_err:
+        print(ckpt_err, file=sys.stderr)
+        return 2
     if args.profile_round > 0 and args.shard:
         print("--profile-round decomposes the LOCAL round (use "
               "experiments/dist_profile.py for the mesh engines)",
@@ -348,7 +407,10 @@ def main(argv: list[str] | None = None) -> int:
     mplan = exists = None
     if args.graph == "matching":
         if args.shard:
-            return _main_shard_matching(args, rng, spec)
+            return _main_shard_matching(
+                args, rng, spec, resume=resume,
+                local=getattr(args, "_resume_local", False),
+            )
         if args.remat_every > 0:
             print("--graph matching cannot re-materialize locally (its "
                   "pairing IS the delivery plan — a folded CSR has no "
@@ -390,7 +452,7 @@ def main(argv: list[str] | None = None) -> int:
         graph = topology.build_csr(args.peers, edges)
 
     if args.shard:
-        return _main_shard(args, graph, rng, spec)
+        return _main_shard(args, graph, rng, spec, resume=resume)
 
     if args.grow and args.graph != "matching":
         from tpu_gossip.growth import pad_graph_for_growth
@@ -455,20 +517,39 @@ def main(argv: list[str] | None = None) -> int:
         else np.arange(graph.n),
     )
     ctl = _compile_cli_control(args)
+    policy = _ckpt_policy(args, shards=1)
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_with_remat(args, cfg, state, scen, grow,
-                                           strm, ctl)
+                                           strm, ctl, policy=policy,
+                                           resume=resume)
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
-            fin, stats = simulate(state, cfg, args.rounds, plan, args.tail,
-                                  scen, grow, strm, ctl)
+            if policy is None and resume is None:
+                fin, stats = simulate(state, cfg, args.rounds, plan,
+                                      args.tail, scen, grow, strm, ctl)
+            else:
+                from tpu_gossip.ckpt import host_stats, run_checkpointed
+
+                state, prefix = _swap_in_resume(resume, state, args)
+
+                def seg_run(st, seg):
+                    st, s = simulate(st, cfg, seg, plan, args.tail, scen,
+                                     grow, strm, ctl)
+                    return st, host_stats(s)
+
+                fin, sd = run_checkpointed(
+                    state, args.rounds, seg_run, policy=policy,
+                    stats_prefix=prefix, log=_stderr_log,
+                )
+                stats, _ici = _split_host_stats(sd)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats,
                                        **_scenario_summary(spec, stats),
                                        **_stream_summary(args, cfg, stats),
                                        **_control_summary(args, cfg, stats))
+            summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
             if scen is None and grow is None and ctl is None:
                 result, fin = M.bench_swarm(
@@ -536,6 +617,16 @@ def _main_fleet(argv: list[str]) -> int:
     )
     p.add_argument("--quiet", action="store_true",
                    help="omit per-lane digests from the summary row")
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="K",
+        help="durable periodic checkpointing of the whole lane stack "
+        "(one file per LANE — per-lane recovery is loading one file); "
+        "`run_sim resume D` finishes the campaign bit-identically, "
+        "`resume D --lane K --solo` recovers one lane unbatched",
+    )
+    p.add_argument("--checkpoint-dir", type=str, default="", metavar="D")
+    p.add_argument("--keep", type=int, default=0, metavar="N",
+                   help="retention: keep the newest N checkpoints (0 = all)")
     args = p.parse_args(argv)
 
     from tpu_gossip import fleet
@@ -577,6 +668,50 @@ def _main_fleet(argv: list[str]) -> int:
         print("fleet: --lane selects the --solo lane; drop it for the "
               "batched run (every lane runs)", file=sys.stderr)
         return 2
+    if args.checkpoint_every < 0 or args.keep < 0:
+        print("fleet: --checkpoint-every and --keep must be >= 0",
+              file=sys.stderr)
+        return 2
+    if args.checkpoint_every and not args.checkpoint_dir:
+        print("fleet: --checkpoint-every needs --checkpoint-dir D",
+              file=sys.stderr)
+        return 2
+    if args.checkpoint_dir and not args.checkpoint_every:
+        print("fleet: --checkpoint-dir shapes periodic checkpointing; "
+              "add --checkpoint-every K", file=sys.stderr)
+        return 2
+    if args.checkpoint_every and args.checkpoint_every >= camp.rounds:
+        print(f"fleet: --checkpoint-every {args.checkpoint_every} must "
+              f"be below the campaign horizon ({camp.rounds} rounds)",
+              file=sys.stderr)
+        return 2
+
+    policy = _fleet_policy(args, camp, args.campaign,
+                           report=args.report, quiet=args.quiet)
+    if policy is not None:
+        # the durable path: segmented simulate_fleet with per-lane
+        # checkpoint files between segments (ckpt/driver.py) — the AOT
+        # single-shot below cannot stop to save
+        from tpu_gossip.ckpt import host_stats, run_checkpointed
+
+        def seg_run(st, seg):
+            st, s = fleet.simulate_fleet(
+                st, camp.cfg, seg, camp.scenario, camp.growth,
+                camp.stream, camp.control,
+            )
+            return st, host_stats(s)
+
+        t0 = _time.perf_counter()
+        fin, sd = run_checkpointed(
+            camp.states, camp.rounds, seg_run, policy=policy,
+            round_axis=1, log=_stderr_log,
+        )
+        wall = _time.perf_counter() - t0
+        camp.states, camp.consumed = fin, True  # the input was donated
+        stats = _split_host_stats(sd)[0]
+        return _emit_fleet_summary(camp, fin, stats, wall,
+                                   quiet=args.quiet,
+                                   report_path=args.report)
 
     # AOT-compile the one batched program, then run the horizon ONCE:
     # swarm_rounds_per_sec is the batching headline and a compile inside
@@ -597,14 +732,55 @@ def _main_fleet(argv: list[str]) -> int:
     float(fin.round[0])  # fetch = completion barrier
     wall = _time.perf_counter() - t0
     camp.states, camp.consumed = fin, True  # the input was donated
+    return _emit_fleet_summary(camp, fin, stats, wall, quiet=args.quiet,
+                               report_path=args.report)
+
+
+def _fleet_policy(a, camp, campaign_path, *, report="", quiet=False):
+    """The fleet run's :class:`~tpu_gossip.ckpt.CheckpointPolicy` (one
+    checkpoint file per lane), or None."""
+    if not getattr(a, "checkpoint_every", 0):
+        return None
+    from tpu_gossip.ckpt import CheckpointPolicy
+
+    return CheckpointPolicy(
+        every=a.checkpoint_every,
+        directory=a.checkpoint_dir,
+        keep=a.keep,
+        shards=camp.k,
+        kind="fleet",
+        run_config={
+            "campaign": campaign_path, "report": report,
+            "quiet": bool(quiet),
+            "checkpoint_every": a.checkpoint_every,
+            "checkpoint_dir": a.checkpoint_dir, "keep": a.keep,
+        },
+    )
+
+
+def _emit_fleet_summary(camp, fin, stats, wall, *, quiet, report_path,
+                        rounds_timed: int | None = None) -> int:
+    """The campaign's certification summary + optional full report —
+    one emitter for the AOT, checkpointed, and resumed paths, so a
+    resumed campaign prints the identical schema (and identical lane
+    digests) the uninterrupted one would. ``rounds_timed`` is how many
+    rounds ``wall`` actually covers (a RESUMED run timed only the
+    post-crash remainder — the throughput figure must not claim the
+    whole horizon for it)."""
+    import jax
+
+    from tpu_gossip import fleet
 
     report = fleet.campaign_report(camp, stats)
+    timed = camp.rounds if rounds_timed is None else rounds_timed
     summary = {
         "summary": True, "fleet": True, "campaign": camp.name,
         "lanes": camp.k, "rounds": camp.rounds,
         "n_peers": int(camp.base.get("peers", 0)),
         "wall_seconds": round(wall, 3),
-        "swarm_rounds_per_sec": round(camp.k * camp.rounds / max(wall, 1e-9), 2),
+        "swarm_rounds_per_sec": round(
+            camp.k * timed / max(wall, 1e-9), 2
+        ),
         "families": [
             {k: f.get(k) for k in (
                 "family", "lanes", "lanes_judged", "reliability",
@@ -613,7 +789,7 @@ def _main_fleet(argv: list[str]) -> int:
             for f in report["families"]
         ],
     }
-    if not args.quiet:
+    if not quiet:
         summary["lane_digests"] = {
             str(k): fleet.state_digest(jax.tree.map(lambda x: x[k], fin))
             for k in range(camp.k)
@@ -622,11 +798,203 @@ def _main_fleet(argv: list[str]) -> int:
             str(k): fleet.stats_digest(stats, k) for k in range(camp.k)
         }
     print(json.dumps(summary))
-    if args.report:
-        with open(args.report, "w") as f:
+    if report_path:
+        with open(report_path, "w") as f:
             json.dump(report, f, indent=1)
             f.write("\n")
     return 0
+
+
+def _main_resume(argv: list[str]) -> int:
+    """``run_sim resume D``: crash recovery from the newest COMPLETE
+    checkpoint under ``D`` (tpu_gossip/ckpt/, docs/checkpointing.md).
+
+    Torn/corrupt checkpoints — no manifest, missing or truncated shard,
+    digest mismatch — are rolled back past with a logged reason. The
+    run config recorded in the manifest rebuilds the exact layout
+    (graphs and plans are deterministic in the seed), the checkpointed
+    state drops in, and the horizon finishes: final state and
+    integer-stat trajectory are bit-identical to the uninterrupted run
+    (the summary carries state/stats digests to prove it). Resumed runs
+    keep checkpointing into the same directory, so repeated crashes
+    compose.
+    """
+    p = argparse.ArgumentParser(
+        prog="run_sim resume",
+        description="Resume a checkpointed run bit-exactly "
+        "(docs/checkpointing.md)",
+    )
+    p.add_argument("directory", help="the run's --checkpoint-dir")
+    p.add_argument("--quiet", action="store_true",
+                   help="summary line only (overrides the recorded flag)")
+    p.add_argument(
+        "--local", action="store_true",
+        help="restore a --shard --graph matching checkpoint into the "
+        "LOCAL engine (S'=1): the recorded S-shard layout is rebuilt, "
+        "the state drops in globally, and the horizon finishes without "
+        "a mesh — bit-identical to finishing on the mesh (the s=1 "
+        "layout-truth contract in reverse)",
+    )
+    p.add_argument("--lane", type=int, default=-1, metavar="K",
+                   help="fleet checkpoints: resume lane K solo (with "
+                   "--solo) instead of the whole stack")
+    p.add_argument("--solo", action="store_true",
+                   help="with --lane K on a fleet checkpoint: finish lane "
+                   "K unbatched through the plain simulate and print its "
+                   "digests (the per-lane recovery oracle)")
+    rargs = p.parse_args(argv)
+
+    from tpu_gossip.ckpt import (
+        CheckpointError,
+        latest_complete,
+        load_checkpoint,
+    )
+
+    try:
+        path, manifest = latest_complete(rargs.directory, log=_stderr_log)
+    except CheckpointError as e:
+        print(f"resume: {e}", file=sys.stderr)
+        return 2
+    run_cfg = manifest.get("run")
+    if not run_cfg:
+        print("resume: the checkpoint manifest carries no run config "
+              "(library-written checkpoint?) — resume rebuilds the run "
+              "from the manifest's `run` section", file=sys.stderr)
+        return 2
+    if manifest.get("kind") == "fleet":
+        if rargs.local:
+            print("resume: --local restores a sharded-matching RUN "
+                  "checkpoint; fleet checkpoints resume batched (or one "
+                  "lane via --lane K --solo)", file=sys.stderr)
+            return 2
+        return _resume_fleet(rargs, path, manifest)
+    if rargs.lane >= 0 or rargs.solo:
+        print("resume: --lane/--solo select a fleet checkpoint's lane; "
+              "this is a single-run checkpoint", file=sys.stderr)
+        return 2
+
+    base = vars(build_parser().parse_args([]))
+    # layout facts the policy records beside the args (checked by the
+    # engine paths, not parser flags) + the validators' settled extras
+    known_extra = {"devices", "control_lo", "control_hi"}
+    stale = sorted(set(run_cfg) - set(base) - known_extra)
+    args = argparse.Namespace(**{**base, **run_cfg})
+    if stale:
+        # recorded-but-unknown keys ride along harmlessly (a removed
+        # flag); note them so a format drift is visible
+        print(f"resume: manifest records unknown args {stale} (ignored "
+              "beyond layout checks)", file=sys.stderr)
+    args.quiet = bool(rargs.quiet or args.quiet)
+    if rargs.local:
+        if not (run_cfg.get("shard") and run_cfg.get("graph") == "matching"
+                and not run_cfg.get("remat_every")):
+            print("resume: --local restores a --shard --graph matching "
+                  "checkpoint (no --remat-every) into the local engine",
+                  file=sys.stderr)
+            return 2
+        args._resume_local = True
+    print(f"resume: {path.name} at round {manifest['round']} of "
+          f"{args.rounds} ({manifest.get('kind', 'run')})",
+          file=sys.stderr)
+    try:
+        state, prefix, _ = load_checkpoint(path, manifest=manifest)
+        return _run(args, resume=(state, prefix, manifest))
+    except (CheckpointError, ValueError) as e:
+        print(f"resume: {e}", file=sys.stderr)
+        return 2
+
+
+def _resume_fleet(rargs, path, manifest) -> int:
+    """Fleet crash recovery: rebuild the campaign from the recorded TOML,
+    drop the checkpointed lane stack (or one lane, ``--lane K --solo``)
+    in, finish the horizon, and emit the same certification summary the
+    uninterrupted run would have — lane digests bit-identical."""
+    from tpu_gossip import fleet
+    from tpu_gossip.ckpt import (
+        CheckpointError,
+        host_stats,
+        load_checkpoint,
+        run_checkpointed,
+    )
+    from tpu_gossip.faults import ScenarioError
+
+    run_cfg = manifest["run"]
+    try:
+        spec = fleet.parse_campaign(run_cfg["campaign"])
+        camp = fleet.compile_campaign(spec)
+    except (fleet.CampaignError, ScenarioError, OSError, KeyError) as e:
+        print(f"resume: cannot rebuild campaign "
+              f"{run_cfg.get('campaign')!r}: {e}", file=sys.stderr)
+        return 2
+
+    if rargs.solo or rargs.lane >= 0:
+        if not (rargs.solo and rargs.lane >= 0):
+            print("resume: per-lane recovery needs BOTH --lane K and "
+                  "--solo", file=sys.stderr)
+            return 2
+        try:
+            st, _prefix, _ = load_checkpoint(path, lane=rargs.lane,
+                                             manifest=manifest)
+        except CheckpointError as e:
+            print(f"resume: {e}", file=sys.stderr)
+            return 2
+        from tpu_gossip.sim import metrics as M
+        from tpu_gossip.sim.engine import simulate
+
+        _st0, sc, gr, sp, cp = camp.lane(rargs.lane)
+        remaining = camp.rounds - int(np.asarray(st.round))
+        fin, _stats = simulate(st, camp.cfg, remaining, None, "fused",
+                               sc, gr, sp, cp)
+        print(json.dumps({
+            "summary": True, "fleet": "solo-resume",
+            "campaign": camp.name, "lane": rargs.lane,
+            "state_digest": fleet.state_digest(fin),
+        }))
+        return 0
+
+    try:
+        state, prefix, _ = load_checkpoint(path, manifest=manifest)
+    except CheckpointError as e:
+        print(f"resume: {e}", file=sys.stderr)
+        return 2
+    start_round = int(np.asarray(state.round).reshape(-1)[0])
+    if start_round >= camp.rounds:
+        print("resume: checkpoint round is past the campaign horizon — "
+              "nothing to resume", file=sys.stderr)
+        return 2
+    policy = _fleet_policy(
+        argparse.Namespace(
+            checkpoint_every=run_cfg.get("checkpoint_every", 0),
+            checkpoint_dir=run_cfg.get("checkpoint_dir", ""),
+            keep=run_cfg.get("keep", 0),
+        ),
+        camp, run_cfg.get("campaign", ""),
+        report=run_cfg.get("report", ""), quiet=run_cfg.get("quiet", False),
+    )
+
+    def seg_run(st, seg):
+        st, s = fleet.simulate_fleet(
+            st, camp.cfg, seg, camp.scenario, camp.growth, camp.stream,
+            camp.control,
+        )
+        return st, host_stats(s)
+
+    import time as _time
+
+    t0 = _time.perf_counter()
+    fin, sd = run_checkpointed(
+        state, camp.rounds, seg_run, policy=policy, stats_prefix=prefix,
+        round_axis=1, log=_stderr_log,
+    )
+    wall = _time.perf_counter() - t0
+    camp.states, camp.consumed = fin, True
+    stats = _split_host_stats(sd)[0]
+    quiet = bool(rargs.quiet or run_cfg.get("quiet"))
+    return _emit_fleet_summary(
+        camp, fin, stats, wall, quiet=quiet,
+        report_path=run_cfg.get("report", ""),
+        rounds_timed=camp.rounds - start_round,
+    )
 
 
 def _validate_grow(args, spec):
@@ -786,6 +1154,167 @@ def _validate_control(args):
                 "(rewire_targets) — only re-wired peers carry swappable "
                 "fresh edges; add --rewire-slots (with churn) or --grow")
     return None
+
+
+def _validate_ckpt(args):
+    """Normalize + reject impossible checkpointing configs; returns an
+    error string (exit 2) or None — the durability twin of
+    :func:`_validate_grow`."""
+    if args.checkpoint_every < 0:
+        return "--checkpoint-every must be >= 0"
+    if args.checkpoint_every == 0:
+        set_flags = [
+            name for name, dflt in (
+                ("--checkpoint-dir", args.checkpoint_dir == ""),
+                ("--keep", args.keep == 0),
+                ("--checkpoint-shards", args.checkpoint_shards == 0),
+            ) if not dflt
+        ]
+        if set_flags:
+            return (f"{set_flags[0]} shapes periodic checkpointing; add "
+                    "--checkpoint-every K")
+        return None
+    if not args.checkpoint_dir:
+        return ("--checkpoint-every needs --checkpoint-dir D — the "
+                "durable directory the ckpt-<round> checkpoints land in")
+    if args.rounds <= 0:
+        return ("--checkpoint-every segments a FIXED horizon; a "
+                "run-to-coverage loop is a single on-device while_loop "
+                "with no deterministic segment grid to cut at — pass "
+                "--rounds R")
+    if args.profile_round > 0:
+        return ("--profile-round slope-times the round's stages instead "
+                "of running a horizon; drop the checkpoint flags")
+    if args.keep < 0 or args.checkpoint_shards < 0:
+        return "--keep and --checkpoint-shards must be >= 0"
+    if args.checkpoint_every >= args.rounds:
+        return (f"--checkpoint-every {args.checkpoint_every} must be "
+                f"below --rounds {args.rounds}, or no checkpoint would "
+                "ever land inside the horizon")
+    if args.shard and args.remat_every > 0 \
+            and args.checkpoint_every % args.remat_every != 0:
+        return ("--checkpoint-every must be a MULTIPLE of --remat-every "
+                "under --shard: mid-epoch mesh state cannot be re-placed "
+                "without that epoch's partition tables, so checkpoints "
+                "land at epoch boundaries (pre-fold) and resume replays "
+                "the fold + re-partition deterministically "
+                "(docs/checkpointing.md)")
+    return None
+
+
+def _ckpt_policy(args, shards: int, kind: str = "run", extra: dict | None = None):
+    """The settled :class:`~tpu_gossip.ckpt.CheckpointPolicy` for this
+    run, or None. ``shards`` is the engine path's natural file-shard
+    default (mesh size on the mesh, 1 locally); ``extra`` adds
+    layout facts (device count) the resume path must re-check."""
+    if args.checkpoint_every <= 0:
+        return None
+    from tpu_gossip.ckpt import CheckpointPolicy
+
+    run_cfg = _manifest_run_config(args)
+    if extra:
+        run_cfg.update(extra)
+    return CheckpointPolicy(
+        every=args.checkpoint_every,
+        directory=args.checkpoint_dir,
+        keep=args.keep,
+        shards=args.checkpoint_shards or shards,
+        kind=kind,
+        run_config=run_cfg,
+    )
+
+
+def _manifest_run_config(args) -> dict:
+    """The manifest's ``run`` section: every settled CLI arg (the
+    validators' mutations included — grow_rate, slot_ttl, control
+    bounds), so ``run_sim resume`` rebuilds the exact run without
+    re-deriving anything."""
+    return {
+        k: v for k, v in vars(args).items()
+        if not k.startswith("_")
+        and (v is None or isinstance(v, (str, int, float, bool)))
+    }
+
+
+def _stderr_log(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+def _split_host_stats(sd: dict):
+    """A concatenated driver stats dict back into ``(RoundStats, IciRound
+    | None)`` — the transport counters ride the ``ici__`` prefix."""
+    from tpu_gossip.sim.engine import RoundStats
+
+    stats = RoundStats(*(sd[f] for f in RoundStats._fields))
+    ici = None
+    if any(k.startswith("ici__") for k in sd):
+        from tpu_gossip.dist.transport import IciRound
+
+        ici = IciRound(*(sd[f"ici__{f}"] for f in IciRound._fields))
+    return stats, ici
+
+
+def _swap_in_resume(resume, state, args):
+    """Replace the freshly built initial state with the checkpointed one
+    (plans/layouts were rebuilt deterministically from the recorded
+    args; the state is the only thing the crash interrupted). Returns
+    ``(state, stats_prefix)``; layout mismatches fail with a named
+    reason, not a shape error inside jit."""
+    if resume is None:
+        return state, None
+    from tpu_gossip.ckpt import CheckpointError
+
+    loaded, prefix, manifest = resume
+    if int(loaded.seen.shape[0]) != int(state.seen.shape[0]) or \
+            int(loaded.seen.shape[1]) != int(state.seen.shape[1]):
+        raise CheckpointError(
+            f"checkpoint state is (N={loaded.seen.shape[0]}, "
+            f"M={loaded.seen.shape[1]}) but the rebuilt run layout is "
+            f"(N={state.seen.shape[0]}, M={state.seen.shape[1]}) — the "
+            "manifest's recorded config no longer reproduces this layout"
+        )
+    if int(manifest.get("round", 0)) >= args.rounds:
+        raise CheckpointError(
+            f"checkpoint round {manifest.get('round')} is not inside the "
+            f"run's horizon ({args.rounds} rounds) — nothing to resume"
+        )
+    return loaded, prefix
+
+
+def _check_resume_devices(resume, mesh_size: int) -> None:
+    """A mesh checkpoint re-places onto a mesh of the SAME size (the run
+    layout was built for it); a mismatch is a named config error. The
+    matching family additionally restores into S'=1 via
+    ``run_sim resume D --local`` (the layout-truth contract in
+    reverse)."""
+    if resume is None:
+        return
+    from tpu_gossip.ckpt import CheckpointError
+
+    recorded = (resume[2].get("run") or {}).get("devices")
+    if recorded is not None and int(recorded) != int(mesh_size):
+        raise CheckpointError(
+            f"checkpoint was written by a {recorded}-device mesh run but "
+            f"this process has {mesh_size} devices — resume on a "
+            f"{recorded}-device mesh, or (sharded matching) restore into "
+            "the local engine with `run_sim resume D --local`"
+        )
+
+
+def _digest_summary(args, fin, stats, policy=None, resume=None) -> dict:
+    """state/stats digests for the summary row — the recovery contract's
+    comparison keys (sha256 over every state leaf / every integer stat
+    track, the fleet engine's cross-process fingerprints)."""
+    if not (args.digest or policy is not None or resume is not None):
+        return {}
+    if stats is None:
+        return {}
+    from tpu_gossip.fleet.engine import state_digest, stats_digest
+
+    return {
+        "state_digest": state_digest(fin),
+        "stats_digest": stats_digest(stats),
+    }
 
 
 def _compile_cli_control(args):
@@ -1060,7 +1589,7 @@ def _main_profile_round(args, cfg, state, plan, grow=None, strm=None,
 
 
 def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
-                    ctl=None):
+                    ctl=None, policy=None, resume=None):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
     The first re-materialization pads col_idx to the fixed capacity, so the
@@ -1099,6 +1628,55 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
             np.asarray(st.row_ptr), np.asarray(st.col_idx),
             fanout=None if args.mode == "flood" else args.fanout,
         )
+
+    if policy is not None or resume is not None:
+        # the durable path (ckpt/driver.py): cut the horizon at BOTH the
+        # remat grid and the checkpoint grid, save between segments,
+        # fold at epoch boundaries via the driver's fold hook (a resumed
+        # epoch-boundary checkpoint replays its fold first). `cap` above
+        # came from the FRESH initial state, exactly what the
+        # uninterrupted loop used — so the resumed folds are
+        # bit-identical. ms-per-round timing is not a headline here;
+        # compiles land in the wall like any cold run.
+        from tpu_gossip.ckpt import host_stats, run_checkpointed
+
+        state, prefix = _swap_in_resume(resume, state, args)
+
+        def fold(st):
+            nonlocal remats, overflow_total
+            st, overflow = rematerialize_rewired(st, cfg, cap)
+            remats += 1
+            overflow_total += int(overflow)
+            return st
+
+        def seg_run(st, seg):
+            st, s = simulate(st, cfg, seg, seg_plan(st), args.tail, scen,
+                             grow, strm, ctl)
+            return st, host_stats(s)
+
+        t0 = _time.perf_counter()
+        fin, sd = run_checkpointed(
+            state, total, seg_run, policy=policy, stats_prefix=prefix,
+            fold_every=r, fold=fold, log=_stderr_log,
+        )
+        wall = _time.perf_counter() - t0
+        stats, _ici = _split_host_stats(sd)
+        if not args.quiet:
+            M.write_jsonl(stats, sys.stdout)
+        summary = _horizon_summary(
+            args, stats,
+            remat_every=r,
+            # folds are a pure function of the round grid — report the
+            # whole-horizon count so a resumed summary matches the
+            # uninterrupted one (overflow counts this process's folds)
+            remats=(total - 1) // r,
+            remat_overflow_edges=overflow_total,
+            wall_seconds=wall,
+            **_stream_summary(args, cfg, stats),
+            **_control_summary(args, cfg, stats),
+        )
+        summary.update(_digest_summary(args, fin, stats, policy, resume))
+        return summary, fin
 
     def run_segment(st, seg, plan):
         if args.rounds > 0:
@@ -1155,10 +1733,12 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
         ))
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
-        return _horizon_summary(
+        summary = _horizon_summary(
             args, stats, **extra, **_stream_summary(args, cfg, stats),
             **_control_summary(args, cfg, stats),
-        ), state
+        )
+        summary.update(_digest_summary(args, state, stats))
+        return summary, state
     rounds = int(state.round)
     summary = {
         "summary": True, "mode": args.mode, "n_peers": args.peers,
@@ -1200,7 +1780,7 @@ def _horizon_summary(args, stats, **extra):
 
 
 def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
-                          ctl=None, pipe=None):
+                          ctl=None, pipe=None, policy=None, resume=None):
     """The mesh epoch loop (SURVEY.md §7.4's full churn lifecycle):
 
         R churned rounds -> fold fresh edges into the CSR
@@ -1240,6 +1820,59 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
         return build_transport(sg_now, mode=args.transport)
 
     transport = transport_for(sg)
+
+    if policy is not None or resume is not None:
+        # the durable path: checkpoints land at EPOCH boundaries only
+        # (parse-enforced: --checkpoint-every is a multiple of
+        # --remat-every), holding the PRE-fold state; the fold hook then
+        # folds + re-partitions with a seed derived from the fold index
+        # (identical to the serial loop's seed sequence), so a resumed
+        # run replays the exact partition the uninterrupted run drew.
+        from tpu_gossip.ckpt import host_stats, run_checkpointed
+        from tpu_gossip.sim import metrics as _M
+
+        nonstate = {"sg": sg, "plans": plans, "transport": transport}
+        loaded, prefix = _swap_in_resume(resume, state, args)
+        state = shard_swarm(loaded, mesh) if resume is not None else state
+
+        def fold(st):
+            k = int(np.asarray(st.round)) // r
+            cap = remat_capacity(st, cfg)
+            st, _overflow = rematerialize_rewired(st, cfg, cap)
+            sg_now, st, _position = repartition_swarm(
+                st, mesh.size, seed=args.seed + k
+            )
+            st = shard_swarm(st, mesh)
+            nonstate["sg"] = sg_now
+            if nonstate["plans"] is not None:
+                nonstate["plans"] = build_shard_plans(sg_now)
+            nonstate["transport"] = transport_for(sg_now)
+            return st
+
+        def seg_run(st, seg):
+            st, s = simulate_dist(
+                st, cfg, nonstate["sg"], mesh, seg, nonstate["plans"],
+                scen, None, nonstate["transport"], control=ctl,
+                pipeline=pipe,
+            )
+            return st, host_stats(s)
+
+        t0 = _time.perf_counter()
+        fin, sd = run_checkpointed(
+            state, total, seg_run, policy=policy, stats_prefix=prefix,
+            fold_every=r, fold=fold, log=_stderr_log,
+        )
+        wall = _time.perf_counter() - t0
+        stats, _ici = _split_host_stats(sd)
+        if not args.quiet:
+            _M.write_jsonl(stats, sys.stdout)
+        summary = _horizon_summary(
+            args, stats, devices=mesh.size, remat_every=r,
+            remats=(total - 1) // r, wall_seconds=wall,
+            **_control_summary(args, cfg, stats),
+        )
+        summary.update(_digest_summary(args, fin, stats, policy, resume))
+        return summary, fin
 
     # warm the first segment outside the timed region (same static shapes)
     # on a throwaway clone — the dist engines donate their state
@@ -1303,9 +1936,11 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
         ))
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
-        return _horizon_summary(
+        summary = _horizon_summary(
             args, stats, **extra, **_control_summary(args, cfg, stats)
-        ), state
+        )
+        summary.update(_digest_summary(args, state, stats))
+        return summary, state
     rounds = int(state.round)
     sim_wall = wall - rebuild_s
     summary = {
@@ -1321,7 +1956,8 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
     return summary, state
 
 
-def _main_shard_matching(args, rng, spec=None) -> int:
+def _main_shard_matching(args, rng, spec=None, resume=None,
+                         local=False) -> int:
     """--shard --graph matching: the gather-free pipeline on the mesh.
 
     The swarm is laid out per shard at build time
@@ -1332,6 +1968,13 @@ def _main_shard_matching(args, rng, spec=None) -> int:
     engine over the exported CSR (``partition_graph``): a re-materialized
     CSR has no pairing pipeline, and the bucket engine owns the epoch
     re-partition lifecycle.
+
+    ``local=True`` (``run_sim resume D --local``) is the resharding
+    contract's S'=1 leg: the SAME S-shard layout is rebuilt from the
+    manifest's recorded device count, the checkpoint's global state
+    drops straight in, and the horizon finishes on the LOCAL engine over
+    the un-placed plan — the s=1 layout-truth contract run in reverse,
+    bit-identical to finishing on the mesh (tests/sim/test_ckpt.py).
     """
     import jax
 
@@ -1357,7 +2000,8 @@ def _main_shard_matching(args, rng, spec=None) -> int:
             args.peers, gamma=args.gamma, fanout=None,
             key=jax.random.key(args.seed),
         )
-        return _main_shard(args, dgraph.to_host_graph(), rng, spec)
+        return _main_shard(args, dgraph.to_host_graph(), rng, spec,
+                           resume=resume)
 
     if args.remat_every > 0:
         return fallback_to_csr_shard(
@@ -1372,29 +2016,50 @@ def _main_shard_matching(args, rng, spec=None) -> int:
         matching_powerlaw_graph_sharded,
     )
 
-    mesh = make_mesh()
-    if 128 % mesh.size:
-        # the transpose all_to_all splits the 128-lane axis; a mesh size
-        # that does not divide 128 cannot run the sharded matching layout
-        return fallback_to_csr_shard(
-            f"mesh size {mesh.size} does not divide 128 (the sharded "
-            "matching transpose's lane split)"
-        )
+    if local:
+        from tpu_gossip.ckpt import CheckpointError
+
+        run_cfg = (resume[2].get("run") or {}) if resume else {}
+        n_build = int(run_cfg.get("devices") or 0)
+        if n_build <= 0:
+            raise CheckpointError(
+                "checkpoint manifest records no device count — cannot "
+                "rebuild the sharded matching layout for a local restore"
+            )
+        mesh = None
+        if args.transport != "dense":
+            print("note: the recorded --transport compacts MESH "
+                  "collectives; the local restore moves no ICI bytes "
+                  "(trajectory unchanged — the transport reorders bytes, "
+                  "never draws)", file=sys.stderr)
+    else:
+        mesh = make_mesh()
+        if 128 % mesh.size:
+            # the transpose all_to_all splits the 128-lane axis; a mesh
+            # size that does not divide 128 cannot run the sharded
+            # matching layout
+            return fallback_to_csr_shard(
+                f"mesh size {mesh.size} does not divide 128 (the sharded "
+                "matching transpose's lane split)"
+            )
+        _check_resume_devices(resume, mesh.size)
+        n_build = mesh.size
     dgraph, plan = matching_powerlaw_graph_sharded(
-        args.peers, mesh.size, gamma=args.gamma,
+        args.peers, n_build, gamma=args.gamma,
         fanout=None if args.mode == "flood" else args.fanout,
         key=jax.random.key(args.seed),
         growth_rows=(
-            -(-(args.grow_capacity - args.peers) // mesh.size)
+            -(-(args.grow_capacity - args.peers) // n_build)
             if args.grow else 0
         ),
     )
-    plan = shard_matching_plan(plan, mesh)
+    if not local:
+        plan = shard_matching_plan(plan, mesh)
     from tpu_gossip.dist import build_transport
 
     transport = (
         build_transport(plan, mode=args.transport, mesh=mesh)
-        if args.transport != "dense" else None
+        if args.transport != "dense" and not local else None
     )
     cfg = SwarmConfig(
         n_peers=plan.n,  # per-shard blocks incl. born-dead pad rows
@@ -1421,41 +2086,81 @@ def _main_shard_matching(args, rng, spec=None) -> int:
     )
     if silent_ids is not None:
         state.silent = state.silent.at[to_rows(silent_ids)].set(True)
-    state = shard_swarm(state, mesh)
+    if not local:
+        state = shard_swarm(state, mesh)
 
     scen = _compile_cli_scenario(
         spec, args, n_slots=plan.n, node_map=to_rows,
         shard_ranges=[(s * plan.n_blk, (s + 1) * plan.n_blk)
-                      for s in range(mesh.size)],
-        n_shards=mesh.size,
+                      for s in range(n_build)],
+        n_shards=n_build,
     )
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
     strm = _compile_cli_stream(args, to_rows(np.arange(args.peers)))
     ctl = _compile_cli_control(args)
     pipe = _compile_cli_pipeline(args)
+    policy = _ckpt_policy(args, shards=n_build, extra={"devices": n_build})
     with trace(args.profile):
         if args.rounds > 0:
-            if transport is not None:
-                fin, (stats, ici) = simulate_dist(
-                    state, cfg, plan, mesh, args.rounds, None, scen, grow,
-                    transport, True, strm, ctl, pipe,
-                )
+            if policy is None and resume is None:
+                if transport is not None:
+                    fin, (stats, ici) = simulate_dist(
+                        state, cfg, plan, mesh, args.rounds, None, scen,
+                        grow, transport, True, strm, ctl, pipe,
+                    )
+                else:
+                    fin, stats = simulate_dist(state, cfg, plan, mesh,
+                                               args.rounds, None, scen,
+                                               grow, stream=strm,
+                                               control=ctl, pipeline=pipe)
+                    ici = None
             else:
-                fin, stats = simulate_dist(state, cfg, plan, mesh,
-                                           args.rounds, None, scen, grow,
-                                           stream=strm, control=ctl,
-                                           pipeline=pipe)
-                ici = None
+                from tpu_gossip.ckpt import host_stats, run_checkpointed
+                from tpu_gossip.sim.engine import simulate
+
+                loaded, prefix = _swap_in_resume(resume, state, args)
+                if resume is not None:
+                    state = loaded if local else shard_swarm(loaded, mesh)
+                if local and prefix is not None:
+                    # a sparse-transport run's prefix carries ici__*
+                    # counters; the local restore ships no ICI bytes, so
+                    # the byte accounting ends at the crash (trajectory
+                    # stats are unaffected — the transport never draws)
+                    prefix = {k: v for k, v in prefix.items()
+                              if not k.startswith("ici__")}
+
+                def seg_run(st, seg):
+                    if local:
+                        st, s = simulate(st, cfg, seg, plan, "fused", scen,
+                                         grow, strm, ctl, pipe)
+                        return st, host_stats(s)
+                    if transport is not None:
+                        st, (s, seg_ici) = simulate_dist(
+                            st, cfg, plan, mesh, seg, None, scen, grow,
+                            transport, True, strm, ctl, pipe,
+                        )
+                        return st, host_stats(s, seg_ici)
+                    st, s = simulate_dist(st, cfg, plan, mesh, seg, None,
+                                          scen, grow, stream=strm,
+                                          control=ctl, pipeline=pipe)
+                    return st, host_stats(s)
+
+                fin, sd = run_checkpointed(
+                    state, args.rounds, seg_run, policy=policy,
+                    stats_prefix=prefix, log=_stderr_log,
+                )
+                stats, ici = _split_host_stats(sd)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(
-                args, stats, devices=mesh.size,
+                args, stats, devices=n_build,
                 **_scenario_summary(spec, stats),
                 **_transport_summary(args, ici, args.rounds),
                 **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
             )
+            summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
             # the timed region runs WITHOUT the analytic counter so the
             # sparse-vs-dense ms_per_round A/B measures pure transport;
@@ -1498,7 +2203,7 @@ def _main_shard_matching(args, rng, spec=None) -> int:
     return 0
 
 
-def _main_shard(args, graph, rng, spec=None) -> int:
+def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     """The --shard path: identical protocol, peers 1-D sharded over every
     available device with bucketed all_to_all fan-out (dist/mesh.py)."""
     import jax
@@ -1564,26 +2269,57 @@ def _main_shard(args, graph, rng, spec=None) -> int:
     strm = _compile_cli_stream(args, position[np.arange(args.peers)])
     ctl = _compile_cli_control(args)
     pipe = _compile_cli_pipeline(args)
+    policy = _ckpt_policy(args, shards=mesh.size,
+                          extra={"devices": mesh.size})
+    _check_resume_devices(resume, mesh.size)
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
-                args, cfg, state, sg, mesh, plans, scen, ctl, pipe
+                args, cfg, state, sg, mesh, plans, scen, ctl, pipe,
+                policy=policy, resume=resume,
             )
             summary.update(_scenario_summary(spec))
             summary.update(_transport_summary(args))
             summary.update(_pipeline_summary(args))
             summary.update(_control_summary(args))
         elif args.rounds > 0:
-            if transport is not None:
-                fin, (stats, ici) = simulate_dist(
-                    state, cfg, sg, mesh, args.rounds, plans, scen, grow,
-                    transport, True, strm, ctl, pipe,
-                )
+            if policy is None and resume is None:
+                if transport is not None:
+                    fin, (stats, ici) = simulate_dist(
+                        state, cfg, sg, mesh, args.rounds, plans, scen, grow,
+                        transport, True, strm, ctl, pipe,
+                    )
+                else:
+                    fin, stats = simulate_dist(state, cfg, sg, mesh,
+                                               args.rounds, plans, scen,
+                                               grow, stream=strm,
+                                               control=ctl, pipeline=pipe)
+                    ici = None
             else:
-                fin, stats = simulate_dist(state, cfg, sg, mesh, args.rounds,
-                                           plans, scen, grow, stream=strm,
-                                           control=ctl, pipeline=pipe)
-                ici = None
+                from tpu_gossip.ckpt import host_stats, run_checkpointed
+                from tpu_gossip.dist import shard_swarm as _reshard
+
+                loaded, prefix = _swap_in_resume(resume, state, args)
+                state = _reshard(loaded, mesh) if resume is not None \
+                    else state
+
+                def seg_run(st, seg):
+                    if transport is not None:
+                        st, (s, seg_ici) = simulate_dist(
+                            st, cfg, sg, mesh, seg, plans, scen, grow,
+                            transport, True, strm, ctl, pipe,
+                        )
+                        return st, host_stats(s, seg_ici)
+                    st, s = simulate_dist(st, cfg, sg, mesh, seg, plans,
+                                          scen, grow, stream=strm,
+                                          control=ctl, pipeline=pipe)
+                    return st, host_stats(s)
+
+                fin, sd = run_checkpointed(
+                    state, args.rounds, seg_run, policy=policy,
+                    stats_prefix=prefix, log=_stderr_log,
+                )
+                stats, ici = _split_host_stats(sd)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(
@@ -1594,6 +2330,7 @@ def _main_shard(args, graph, rng, spec=None) -> int:
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
             )
+            summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
             # the shared timing harness (warmup, fetch barrier) with the
             # dist engine's while_loop swapped in; report the real peer

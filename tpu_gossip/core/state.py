@@ -53,18 +53,31 @@ __all__ = [
     "lane_state",
     "message_slot",
     "message_slots",
+    "saturate_round",
+    "validate_state_planes",
     "save_swarm",
     "load_swarm",
 ]
 
 # declared value cap for every ROUND-NUMBER-valued plane (join_round,
-# slot_lease — and the int16 candidates last_hb/infected_round when they
-# narrow): the widest round index the narrow planes can hold. No tracked
-# run approaches it (the 10M north star converges in tens of rounds; the
-# longest streaming horizons are hundreds) — a campaign that needs more
-# rounds than this widens the declared dtype in PLANES *first*, which is
-# exactly the review the mem tier's width audit forces.
+# slot_lease, last_hb, infected_round): the widest round index the narrow
+# int16 planes can hold. No tracked run approaches it (the 10M north star
+# converges in tens of rounds; the longest streaming horizons are
+# hundreds) — a campaign that needs more rounds than this widens the
+# declared dtype in PLANES *first*, which is exactly the review the mem
+# tier's width audit forces. Every write of the (int32) round cursor into
+# a narrow plane goes through :func:`saturate_round`, so a run past the
+# cap records "at the cap" (late but valid) instead of wrapping into the
+# -1 never/free sentinels.
 ROUND_CAP = 2**15 - 1
+
+
+def saturate_round(rnd, dtype):
+    """The ONE way a round cursor lands in a narrow round-valued plane:
+    saturated at :data:`ROUND_CAP`, cast to the plane's declared dtype.
+    Comparisons stay at the wide cursor (int32 promotion); only the
+    STORED value narrows."""
+    return jnp.minimum(rnd, ROUND_CAP).astype(dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,17 +111,17 @@ PLANES: tuple[PlaneSpec, ...] = (
               "peer row ids: N up to 100M needs 27 bits"),
     PlaneSpec("seen", "bool", "(N, M)", 1, "dedup bit"),
     PlaneSpec("forwarded", "bool", "(N, M)", 1, "relay bit"),
-    PlaneSpec("infected_round", "int32", "(N, M)", 16,
-              "round numbers (<= ROUND_CAP) — int16 is the next narrow; "
-              "kept int32 until its bit-identity matrix is re-pinned"),
+    PlaneSpec("infected_round", "int16", "(N, M)", 16,
+              "round numbers: -1 or a first-receipt round <= ROUND_CAP "
+              "(saturate_round at every latch site)"),
     PlaneSpec("recovered", "bool", "(N, M)", 1,
               "SIR removed bit (with seen: the 2-bit SIR state)"),
     PlaneSpec("exists", "bool", "(N,)", 1, "membership bit"),
     PlaneSpec("alive", "bool", "(N,)", 1, "liveness bit"),
     PlaneSpec("silent", "bool", "(N,)", 1, "fault bit"),
-    PlaneSpec("last_hb", "int32", "(N,)", 16,
-              "round numbers (<= ROUND_CAP) — int16 candidate; kept int32 "
-              "until its matrix is re-pinned"),
+    PlaneSpec("last_hb", "int16", "(N,)", 16,
+              "round numbers: a heartbeat round <= ROUND_CAP "
+              "(saturate_round at every refresh site)"),
     PlaneSpec("declared_dead", "bool", "(N,)", 1, "detector verdict bit"),
     PlaneSpec("rewired", "bool", "(N,)", 1, "re-attach bit"),
     PlaneSpec("rewire_targets", "int32", "(N, S)", 32,
@@ -247,13 +260,13 @@ class SwarmState:
     # dissemination
     seen: jax.Array  # bool (N, M) — hash-slot dedup bitmap
     forwarded: jax.Array  # bool (N, M) — already relayed (forward-once mode)
-    infected_round: jax.Array  # int32 (N, M) — round slot was first received (-1 = never)
+    infected_round: jax.Array  # int16 (N, M) — round slot was first received (-1 = never; <= ROUND_CAP per the PLANES registry)
     recovered: jax.Array  # bool (N, M) — SIR removed state, per slot (multi-rumor safe)
     # liveness
     exists: jax.Array  # bool (N,) — static: slot is a real peer (False: pad/sentinel)
     alive: jax.Array  # bool (N,) — crashed/departed = False
     silent: jax.Array  # bool (N,) — fault injection: no heartbeats / PING replies
-    last_hb: jax.Array  # int32 (N,) — round of last emitted heartbeat
+    last_hb: jax.Array  # int16 (N,) — round of last emitted heartbeat (<= ROUND_CAP per the PLANES registry)
     declared_dead: jax.Array  # bool (N,) — failure-detector verdict (registry purge)
     # churn re-wiring (BASELINE config 5): rejoiners re-attach with fresh
     # degree-preferential edges instead of reusing the departed peer's
@@ -351,9 +364,16 @@ _V1_FIELDS = (
 
 
 def save_swarm(path, state: SwarmState) -> None:
-    """Checkpoint the swarm (reference has none — SURVEY.md §5.4; the whole
-    simulation state is one pytree, so resume is lossless). Arrays are keyed
-    by FIELD NAME so the format survives adding/reordering state fields."""
+    """Checkpoint the swarm as ONE flat npz (reference has none —
+    SURVEY.md §5.4; the whole simulation state is one pytree, so resume
+    is lossless). Arrays are keyed by FIELD NAME so the format survives
+    adding/reordering state fields.
+
+    This is the LEGACY format: no atomicity, no integrity digests, no
+    sharding. The production route is ``tpu_gossip.ckpt`` (sharded
+    atomic writes, manifest-gated torn-write detection, periodic in-run
+    saves, bit-exact crash recovery — docs/checkpointing.md); its
+    loader accepts this format too (``ckpt.load_any``)."""
     arrays = {}
     for f in dataclasses.fields(SwarmState):
         leaf = getattr(state, f.name)
@@ -435,22 +455,86 @@ def load_swarm(path) -> SwarmState:
         kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
         kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
         kwargs["pipe_buf"] = jnp.zeros((n, m), dtype=bool)
-    # declared-width cast: checkpoints written before a plane narrowed
-    # (PLANES registry — join_round/slot_lease int32 -> int16) carry the
-    # old wider dtype; values are bounded by the declared caps (ROUND_CAP
-    # for the round-valued planes), so the cast is lossless, and without
-    # it a restored state would break the round map's dtype fixed point
-    # (contract audit) the first time it rode a scan carry
+    kwargs = cast_to_declared(kwargs)
+    state = SwarmState(**kwargs)
+    validate_state_planes(state, source=str(path))
+    return state
+
+
+def cast_to_declared(kwargs: dict) -> dict:
+    """Declared-width cast: checkpoints written before a plane narrowed
+    (PLANES registry — join_round/slot_lease, then infected_round/last_hb,
+    int32 -> int16) carry the old wider dtype; values are bounded by the
+    declared caps (ROUND_CAP for the round-valued planes), so the cast is
+    lossless, and without it a restored state would break the round map's
+    dtype fixed point (contract audit) the first time it rode a scan
+    carry. Same-kind casts only — a kind mismatch is a foreign/corrupt
+    plane and is left for :func:`validate_state_planes` to name."""
     reg = plane_registry()
-    for name in list(kwargs):
+    out = dict(kwargs)
+    for name in list(out):
         spec = reg.get(name)
         if spec is None or spec.dtype == "key":
             continue
         want = np.dtype(spec.dtype)
-        leaf = kwargs[name]
+        leaf = out[name]
         if leaf.dtype != want and leaf.dtype.kind == want.kind:
-            kwargs[name] = leaf.astype(want)
-    return SwarmState(**kwargs)
+            out[name] = leaf.astype(want)
+    return out
+
+
+def validate_state_planes(state: SwarmState, source: str | None = None) -> None:
+    """Check every restored plane against the PLANES registry and fail
+    with a NAMED-plane error instead of letting a stale or foreign npz
+    surface later as a shape/dtype error inside jit.
+
+    Dims bind from the anchor planes (N from ``seen`` rows, M from its
+    columns, S from ``rewire_targets``, D free from ``col_idx``); every
+    other plane must then realize its declared symbolic shape, and its
+    dtype must be EXACTLY the declared one (the lossless
+    :func:`cast_to_declared` pass has already run on a load path, so any
+    residue is a genuine mismatch — a float plane, a bool where an int
+    belongs)."""
+    where = f" in {source}" if source else ""
+
+    def fail(name, what):
+        raise ValueError(
+            f"checkpoint plane {name!r}{where} {what} — stale or foreign "
+            "checkpoint (the PLANES registry in core/state.py declares "
+            "every plane's dtype and shape)"
+        )
+
+    seen = state.seen
+    if getattr(seen, "ndim", 0) != 2:
+        fail("seen", f"has shape {getattr(seen, 'shape', None)}, "
+             "expected the 2-D (N, M) dedup bitmap")
+    if getattr(state.rewire_targets, "ndim", 0) != 2:
+        fail("rewire_targets",
+             f"has shape {getattr(state.rewire_targets, 'shape', None)}, "
+             "expected the 2-D (N, S) fresh-target table")
+    dims = {
+        "N": int(seen.shape[0]),
+        "M": int(seen.shape[1]),
+        "S": int(state.rewire_targets.shape[1]),
+        "D": int(state.col_idx.shape[0]),
+    }
+    for spec in PLANES:
+        leaf = getattr(state, spec.name)
+        if spec.dtype == "key":
+            if not jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+                fail(spec.name, f"has dtype {leaf.dtype}, expected a PRNG key")
+            continue
+        want = np.dtype(spec.dtype)
+        if np.dtype(leaf.dtype) != want:
+            fail(spec.name, f"has dtype {leaf.dtype}, expected {want}")
+        expect = tuple(
+            dims[t.strip()] if t.strip() != "N+1" else dims["N"] + 1
+            for t in spec.shape.strip("()").split(",") if t.strip()
+        )
+        if tuple(leaf.shape) != expect:
+            fail(spec.name, f"has shape {tuple(leaf.shape)}, expected "
+                 f"{expect} at (N={dims['N']}, M={dims['M']}, "
+                 f"S={dims['S']}, D={dims['D']})")
 
 
 def _implied_leases(seen: jax.Array) -> jax.Array:
@@ -590,7 +674,7 @@ def init_swarm(
         key = jax.random.key(0)
     n, m = config.n_peers, config.msg_slots
     seen = jnp.zeros((n, m), dtype=bool)
-    infected_round = jnp.full((n, m), -1, dtype=jnp.int32)
+    infected_round = jnp.full((n, m), -1, dtype=jnp.int16)
     slot_lease = jnp.full((m,), -1, dtype=jnp.int16)
     if origins is not None:
         origins = jnp.asarray(origins)
@@ -642,7 +726,7 @@ def init_swarm(
         # would confuse the donation aliasing
         alive=exists.copy(),
         silent=jnp.zeros((n,), dtype=bool),
-        last_hb=jnp.zeros((n,), dtype=jnp.int32),
+        last_hb=jnp.zeros((n,), dtype=jnp.int16),
         declared_dead=jnp.zeros((n,), dtype=bool),
         rewired=jnp.zeros((n,), dtype=bool),
         rewire_targets=jnp.zeros((n, s), dtype=jnp.int32),

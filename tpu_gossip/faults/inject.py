@@ -321,12 +321,17 @@ def drain_held(state):
     """
     import dataclasses as _dc
 
+    from tpu_gossip.core.state import saturate_round
+
     active = state.alive & ~state.declared_dead
     inc = state.fault_held & active[:, None] & ~state.recovered
     latch = (inc & ~state.seen) & (state.infected_round < 0)
     return _dc.replace(
         state,
         seen=state.seen | inc,
-        infected_round=jnp.where(latch, state.round, state.infected_round),
+        infected_round=jnp.where(
+            latch, saturate_round(state.round, state.infected_round.dtype),
+            state.infected_round,
+        ),
         fault_held=jnp.zeros_like(state.fault_held),
     )

@@ -43,7 +43,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpu_gossip.core.state import ROUND_CAP
+from tpu_gossip.core.state import saturate_round
 from tpu_gossip.core.topology import hill_gamma
 
 __all__ = [
@@ -194,13 +194,15 @@ def apply_growth(
     alive = alive.at[sel].set(True, mode="drop")
     silent = silent.at[sel].set(False, mode="drop")
     declared_dead = declared_dead.at[sel].set(False, mode="drop")
-    last_hb = last_hb.at[sel].set(rnd, mode="drop")
+    last_hb = last_hb.at[sel].set(
+        saturate_round(rnd, last_hb.dtype), mode="drop"
+    )
     # join_round is the narrow (int16) registry plane — scatter the round
     # cursor at the plane's declared width, SATURATED at ROUND_CAP: a
     # campaign past the cap records "joined at the cap" (late but valid)
     # instead of wrapping into the -1 never-joined sentinel
     join_round = join_round.at[sel].set(
-        jnp.minimum(rnd, ROUND_CAP).astype(join_round.dtype), mode="drop"
+        saturate_round(rnd, join_round.dtype), mode="drop"
     )
     admitted_by = admitted_by.at[sel].set(
         jnp.where(seed_ok, seed_id, -1), mode="drop"

@@ -39,9 +39,14 @@ def emit_heartbeats(
     declared-dead peers have had their connections closed (Peer.py:314-320),
     so their heartbeats no longer reach anyone.
     """
+    from tpu_gossip.core.state import saturate_round
+
     tick = (rnd % hb_period_rounds) == 0
     emit = alive & ~silent & ~declared_dead & tick
-    return jnp.where(emit, rnd, last_hb)
+    # the stored heartbeat round narrows to the plane's declared int16
+    # width (saturated at ROUND_CAP); staleness arithmetic below reads it
+    # back at int32 promotion
+    return jnp.where(emit, saturate_round(rnd, last_hb.dtype), last_hb)
 
 
 def detect_failures(
@@ -62,9 +67,14 @@ def detect_failures(
     (Peer.py:310-320 → Seed.py:358-406). Idempotent on already-dead peers,
     mirroring the seeds' early return on re-receipt (Seed.py:373-375).
     """
+    from tpu_gossip.core.state import saturate_round
+
     sweep = (rnd % detect_period_rounds) == 0
-    stale = (rnd - last_hb) > timeout_rounds
+    stale = (rnd - last_hb) > timeout_rounds  # graftlint: disable=mem-widening-cast -- transient staleness staging: the stored plane stays int16; the age subtraction must ride the wide round cursor so runs past ROUND_CAP degrade by saturation, not wraparound
     responsive = alive & ~silent
-    new_last = jnp.where(sweep & stale & responsive, rnd, last_hb)
+    new_last = jnp.where(
+        sweep & stale & responsive, saturate_round(rnd, last_hb.dtype),
+        last_hb,
+    )
     newly_dead = sweep & stale & ~responsive & ~declared_dead
     return new_last, declared_dead | newly_dead

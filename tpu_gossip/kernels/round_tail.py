@@ -92,15 +92,23 @@ def tail_reference(
     sweep: the recycled slot's message is gone everywhere at once, a
     delivery into it this round dies with it.
     """
+    from tpu_gossip.core.state import saturate_round
+
     inc = incoming & receptive
     new_seen = seen | inc
     new_fwd = (forwarded | transmit) if forward_once else forwarded
     newly = inc & ~seen
-    new_ir = jnp.where(newly & (infected_round < 0), rnd, infected_round)
+    # the stored latch value narrows to the plane's declared width
+    # (int16, saturated at ROUND_CAP); the SIR arithmetic below stays at
+    # the wide cursor via int32 promotion
+    new_ir = jnp.where(
+        newly & (infected_round < 0),
+        saturate_round(rnd, infected_round.dtype), infected_round,
+    )
     new_rec = recovered
     if sir_recover_rounds > 0:
         new_rec = recovered | (
-            (new_ir >= 0) & (rnd - new_ir >= sir_recover_rounds)
+            (new_ir >= 0) & (rnd - new_ir >= sir_recover_rounds)  # graftlint: disable=mem-widening-cast -- transient SIR age staging: the stored plane stays int16; the subtraction must ride the wide round cursor so ages past ROUND_CAP cannot wrap
         )
     if fresh is not None:
         fc = _fresh_col(fresh)
@@ -138,6 +146,8 @@ def tail_fused(
     sweeps. Bitwise-equal to :func:`tail_reference` (pure boolean
     algebra: ``(a | b) & ~f & ~e`` has one value however it is
     scheduled)."""
+    from tpu_gossip.core.state import saturate_round
+
     fc = _fresh_col(fresh)
     inc = incoming & receptive
     # keep = ~fresh_row & ~expired_col, folded to one (broadcast) operand
@@ -157,10 +167,12 @@ def tail_fused(
     else:
         new_fwd = forwarded if keep is None else (forwarded & keep)
     latch = (inc & ~seen) & (infected_round < 0)
-    new_ir = jnp.where(latch, rnd, infected_round)
+    new_ir = jnp.where(
+        latch, saturate_round(rnd, infected_round.dtype), infected_round,
+    )
     if sir_recover_rounds > 0:
         new_rec = recovered | (
-            (new_ir >= 0) & (rnd - new_ir >= sir_recover_rounds)
+            (new_ir >= 0) & (rnd - new_ir >= sir_recover_rounds)  # graftlint: disable=mem-widening-cast -- transient SIR age staging: the stored plane stays int16; the subtraction must ride the wide round cursor so ages past ROUND_CAP cannot wrap
         )
     else:
         new_rec = recovered
@@ -208,10 +220,16 @@ def _tail_kernel(
         o_seen[...] = new_seen
 
         ir = ir_ref[...]
+        # rnd arrives pre-saturated at the plane's narrow dtype; the SIR
+        # age arithmetic widens to int32 so the (-1)-sentinel lanes can't
+        # wrap at the cap edge
         new_ir = jnp.where((inc & ~seen) & (ir < 0), rnd, ir)
         rec = rec_ref[...]
         if sir > 0:
-            rec = rec | ((new_ir >= 0) & (rnd - new_ir >= sir))
+            rec = rec | (
+                (new_ir >= 0)
+                & (rnd.astype(jnp.int32) - new_ir.astype(jnp.int32) >= sir)  # graftlint: disable=mem-widening-cast -- transient SIR age staging inside the kernel window: the stored plane stays int16; the subtraction widens so sentinel lanes cannot wrap
+            )
         if keep is not None:
             new_ir = jnp.where(keep, new_ir, -1)
             rec = rec & keep
@@ -282,12 +300,17 @@ def tail_pallas(
     if has_expired:
         args.append(expired[None, :])
         in_specs.append(col_spec)
-    args.append(jnp.asarray(rnd, jnp.int32).reshape(1, 1))
+    from tpu_gossip.core.state import saturate_round
+
+    args.append(
+        saturate_round(jnp.asarray(rnd, jnp.int32), infected_round.dtype)
+        .reshape(1, 1)
+    )
     in_specs.append(rnd_spec)
 
     out_shape = [
         jax.ShapeDtypeStruct((n, m), jnp.bool_),  # seen
-        jax.ShapeDtypeStruct((n, m), jnp.int32),  # infected_round
+        jax.ShapeDtypeStruct((n, m), infected_round.dtype),
         jax.ShapeDtypeStruct((n, m), jnp.bool_),  # recovered
     ]
     out_specs = [row_spec, row_spec, row_spec]

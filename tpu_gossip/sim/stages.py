@@ -270,7 +270,11 @@ def _churn_stage(cfg, burst: bool) -> Stage:
             alive = alive | join
             fresh = join
             silent = silent & ~fresh
-            last_hb = jnp.where(fresh, ctx["rnd"], last_hb)
+            from tpu_gossip.core.state import saturate_round
+
+            last_hb = jnp.where(
+                fresh, saturate_round(ctx["rnd"], last_hb.dtype), last_hb
+            )
             declared_dead = declared_dead & ~fresh
             if cfg.rewire_slots > 0 and ctx["col_idx"].shape[0] > 0:
                 # power-law re-wiring: the arriving peer attaches its

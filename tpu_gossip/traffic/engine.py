@@ -42,7 +42,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from tpu_gossip.core.state import ROUND_CAP
+from tpu_gossip.core.state import saturate_round
 from tpu_gossip.core.streams import TRAFFIC_STREAM_SALT
 
 __all__ = [
@@ -192,7 +192,7 @@ def apply_stream(
         # past the cap ages leases out early instead of wrapping into
         # the free-slot -1 sentinel and losing the lease entirely
         contrib = jnp.where(
-            landed & ~leased, jnp.minimum(rnd, ROUND_CAP), -1
+            landed & ~leased, saturate_round(rnd, lease.dtype), -1
         ).astype(lease.dtype)
         lease = lease.at[sl].max(contrib)
         return lease, (landed, conf)
@@ -211,7 +211,13 @@ def apply_stream(
         .set(True, mode="drop")
     )
     seen = seen | inj
-    infected_round = jnp.where(inj & (infected_round < 0), rnd, infected_round)
+    # like the lease writes above, the latch narrows to the plane's
+    # declared int16 width, saturated at ROUND_CAP
+    infected_round = jnp.where(
+        inj & (infected_round < 0),
+        saturate_round(rnd, infected_round.dtype),
+        infected_round,
+    )
 
     telem = StreamTelemetry(
         offered=n_arr,
