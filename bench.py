@@ -670,6 +670,83 @@ def bench_control(n: int, horizon: int = 48, reps: int = 1,
     }
 
 
+def bench_adv(n: int, horizon: int = 16, reps: int = 1):
+    """Quorum-detector overhead at headline scale (kernels/liveness.py,
+    docs/adversarial_model.md): the hardened detector vs the direct one
+    on the SAME 1M sharded matching swarm, no adversaries — the pure
+    price of the defense (ms/round delta from the suspicion machine's
+    extra row-level work, bytes/peer delta from the three new planes,
+    quoted from the PLANES registry — 5 B/peer at any scale). The
+    attack-vs-defense ACCEPTANCE numbers live in the byzantine_siege
+    demonstration pair (tests/sim/test_adversary.py) and the fleet-smoke
+    campaign; this entry records what a hardened production run pays
+    when nothing is attacking it.
+    """
+    import time as _time
+
+    import jax
+
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.core.state import (
+        SwarmConfig, clone_state, init_swarm, state_bytes_per_peer,
+    )
+    from tpu_gossip.dist import (
+        make_mesh, shard_matching_plan, shard_swarm, simulate_dist,
+    )
+    from tpu_gossip.kernels.liveness import compile_quorum
+
+    mesh = make_mesh()
+    dg, plan = matching_powerlaw_graph_sharded(
+        n, mesh.size, gamma=2.5, fanout=3, key=jax.random.key(0),
+        export_csr=False,
+    )
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=3, mode="push")
+    state = init_swarm(
+        dg.as_padded_graph(), cfg, origins=[0], exists=dg.exists,
+        key=jax.random.key(0),
+    )
+    state = shard_swarm(state, mesh)
+    splan = shard_matching_plan(plan, mesh)
+    quorum = compile_quorum(3, window=4, budget=3)
+
+    def run(liveness):
+        best = float("inf")
+        for _ in range(max(reps, 1)):
+            rep = clone_state(state)  # outside the timer (donation contract)
+            t0 = _time.perf_counter()
+            fin, _ = simulate_dist(rep, cfg, splan, mesh, horizon,
+                                   liveness=liveness)
+            float(fin.coverage(0))  # completion barrier
+            best = min(best, _time.perf_counter() - t0)
+        return round(best / horizon * 1000.0, 4)
+
+    for lv in (None, quorum):  # warm both compiles on throwaway clones
+        fin_w, _ = simulate_dist(clone_state(state), cfg, splan, mesh,
+                                 horizon, liveness=lv)
+        float(fin_w.coverage(0))
+    del fin_w
+
+    direct_ms = run(None)
+    quorum_ms = run(quorum)
+    # the plane cost is registry arithmetic — the REAL peak numbers ride
+    # the mem tier (memory_budget.toml prices every traced entry)
+    bpp = state_bytes_per_peer(n, cfg.msg_slots)
+    plane_bytes = 5.0  # suspect_round i16 + suspect_mark i16 + quarantine b8
+    return {
+        "n_peers": n, "devices": mesh.size, "horizon_rounds": horizon,
+        "quorum_k": quorum.quorum_k, "window": quorum.window,
+        "budget": quorum.budget,
+        "direct_ms_per_round": direct_ms,
+        "quorum_ms_per_round": quorum_ms,
+        "quorum_over_direct_ms": round(quorum_ms - direct_ms, 4),
+        "bytes_per_peer": round(bpp, 1),
+        "suspicion_planes_bytes_per_peer": plane_bytes,
+        "hardware_note": HARDWARE_AB_NOTE,
+    }
+
+
 def bench_churn_remat(dg, *, msg_slots: int = 16, reps: int = 3,
                       remat_every: int = 16, plan=None,
                       rewire_compact_cap: int = 0):
@@ -1660,7 +1737,7 @@ def main(argv: list[str] | None = None) -> int:
         ``section`` — the guard that keeps rc=0 with the headline printed."""
         frac = {"tail_ab": 0.35, "north_star_10m": 0.40, "dist_200k": 0.70,
                 "dist_1m": 0.78, "grow_1m": 0.82, "stream_1m": 0.86,
-                "control_1m": 0.88, "pipeline_1m": 0.89,
+                "control_1m": 0.88, "adv_1m": 0.885, "pipeline_1m": 0.89,
                 "ckpt_1m": 0.893, "fleet_1m": 0.895, "dist_10m": 0.90}[section]
         if elapsed() <= budget_s * frac:
             return False
@@ -1962,6 +2039,13 @@ def main(argv: list[str] | None = None) -> int:
             # the coverage-feedback fanout's acceptance metric
             out["control_1m"] = bench_control(1_000_000, reps=reps)
             flush_detail()
+        if not quick and not skip("adv_1m"):
+            # the quorum failure detector's overhead at 1M on the
+            # matching mesh: hardened vs direct ms/round on the same
+            # swarm + the suspicion planes' bytes/peer (ISSUE 14 — the
+            # price of Byzantine defense when nothing is attacking)
+            out["adv_1m"] = bench_adv(1_000_000, reps=reps)
+            flush_detail()
         if not quick and not skip("pipeline_1m"):
             # pipelined vs serial sharded matching rounds at 1M — the
             # stage-DAG/double-buffer acceptance entry (ISSUE 10), with
@@ -2102,6 +2186,15 @@ def _compact(out: dict) -> dict:
                 c["controlled"]["rounds_to_target"],
             ],
             "rounds_equal_or_better": c["rounds_equal_or_better"],
+        }
+    av = out.get("adv_1m")
+    if av and "direct_ms_per_round" in av:
+        compact["adv_1m"] = {
+            "direct_ms_per_round": av["direct_ms_per_round"],
+            "quorum_ms_per_round": av["quorum_ms_per_round"],
+            "quorum_over_direct_ms": av["quorum_over_direct_ms"],
+            "suspicion_planes_bytes_per_peer":
+                av["suspicion_planes_bytes_per_peer"],
         }
     t = out.get("tail_ab")
     if t and "composed_ms_per_round" in t:

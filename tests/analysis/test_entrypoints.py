@@ -40,7 +40,8 @@ def test_matrix_structural_coverage():
                 assert f"local[{eng},{mode},m={m}]" in names
     for extra in ("churn", "sir", "churn-compact", "scenario", "growth",
                   "stream", "scenario+growth", "scenario+growth+stream",
-                  "control", "scenario+growth+stream+control"):
+                  "control", "scenario+growth+stream+control",
+                  "adversary", "scenario+growth+stream+control+adversary"):
         assert f"local[xla,{extra}]" in names
     for tail in ("reference", "fused", "pallas"):
         assert f"local[xla,tail={tail}]" in names
@@ -62,6 +63,7 @@ def test_matrix_structural_coverage():
         "dist[matching,control]", "dist[bucketed,control]",
         "dist[matching,pipeline]", "dist[bucketed,pipeline]",
         "dist[matching,pipeline+scenario+stream]",
+        "dist[matching,adversary+scenario]",
     ):
         assert n in names, n
 

@@ -415,8 +415,16 @@ def _run_catalogue_entry(path, *, seed=0):
                             total_rounds=rounds)
     ctl = compile_control(target_ratio=0.9, fanout=2, lo=1, hi=4,
                           refresh_every=5, ttl=ttl)
+    # byzantine_siege fields adversaries, which REQUIRE the quorum
+    # defense (the composition it was written for — the same [base]
+    # quorum the catalogue-smoke campaign runs)
+    lqs = None
+    if spec.uses_adversaries:
+        from tpu_gossip.kernels.liveness import compile_quorum
+
+        lqs = compile_quorum(3, window=4, budget=2)
     _, stats = simulate(st, cfg, rounds, scenario=scen, growth=grow,
-                        stream=strm, control=ctl)
+                        stream=strm, control=ctl, liveness=lqs)
     return M.reliability_report(stats, target_ratio=0.9,
                                 coverage_target=0.95)
 

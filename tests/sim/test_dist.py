@@ -2,6 +2,7 @@
 XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -596,6 +597,125 @@ def test_bucketed_scenario_flood_parity_with_single_device(setup):
             np.asarray(getattr(stats_d, f)), np.asarray(getattr(stats_l, f)),
             err_msg=f,
         )
+
+
+def test_matching_dist_adversary_bit_identical(matching_setup):
+    """The ADVERSARIAL extension of the bit-identity contract: a mesh
+    round under Byzantine accusers + forgers + floods (composed with a
+    blackout and churn, under the quorum defense) is bit-identical to the
+    local round — full state (suspicion planes included) plus every
+    integer stat. All adversary draws land at global shape from the
+    registered adversary stream, outside shard_map."""
+    import dataclasses
+
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+    from tpu_gossip.kernels.liveness import compile_quorum
+
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(
+        n_peers=plan.n, msg_slots=8, fanout=2, mode="push_pull",
+        churn_leave_prob=0.002, churn_join_prob=0.02, rewire_slots=2,
+    )
+    st = _matching_state(g, cfg)
+
+    def rows_of(ids):
+        ids = np.asarray(ids)
+        return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+    spec = scenario_from_dict({"name": "siege", "phases": [
+        {"name": "dark", "start": 0, "end": 4, "loss": 0.1, "delay": 0.1,
+         "blackout": {"frac": 0.1, "seed": 9}},
+        {"name": "adv", "start": 4, "end": 8,
+         "accusers": {"frac": 0.05, "seed": 3},
+         "forgers": {"frac": 0.02, "seed": 4},
+         "floods": {"frac": 0.03, "seed": 5},
+         "forge_fanout": 2, "flood_fanout": 3},
+    ]})
+    sc = compile_scenario(
+        spec, n_peers=1500, n_slots=plan.n, total_rounds=8,
+        node_map=rows_of,
+    )
+    q = compile_quorum(3, window=4, budget=2)
+    fin_l, stats_l = simulate(clone_state(st), cfg, 8, plan, "fused", sc,
+                              None, None, None, None, q)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 8, None, sc,
+        liveness=q,
+    )
+    for f in dataclasses.fields(fin_l):
+        la, lb = getattr(fin_l, f.name), getattr(fin_d, f.name)
+        if jnp.issubdtype(la.dtype, jax.dtypes.prng_key):
+            la, lb = jax.random.key_data(la), jax.random.key_data(lb)
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb), err_msg=f.name
+        )
+    for f in stats_l._fields:
+        a = np.asarray(getattr(stats_l, f))
+        if a.dtype.kind in "biu":
+            np.testing.assert_array_equal(
+                a, np.asarray(getattr(stats_d, f)), err_msg=f
+            )
+    # the attack must actually bite, or the parity is vacuous
+    assert int(np.asarray(stats_l.adv_accusations).sum()) > 0
+    assert int(np.asarray(stats_l.adv_forged).sum()) > 0
+    assert int(np.asarray(stats_l.evictions_new).sum()) > 0
+
+
+def test_matching_dist_adversary_composed_bit_identical(matching_setup):
+    """The composed cell: adversary × chaos scenario × stream × control ×
+    pipeline on the mesh vs local — the whole adversarial round (attack
+    scatters, quorum machine, quarantine masking) under a loaded,
+    controlled, double-buffered swarm stays bit-identical."""
+    import dataclasses
+
+    from tpu_gossip.control import compile_control
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+    from tpu_gossip.kernels.liveness import compile_quorum
+    from tpu_gossip.sim.stages import compile_pipeline
+    from tpu_gossip.traffic import compile_stream
+
+    g, plan, plan_m, mesh = matching_setup
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2,
+                      mode="push_pull")
+    st = _matching_state(g, cfg)
+
+    def rows_of(ids):
+        ids = np.asarray(ids)
+        return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+    spec = scenario_from_dict({"name": "siege", "phases": [
+        {"name": "adv", "start": 0, "end": 6,
+         "accusers": {"frac": 0.05, "seed": 3},
+         "floods": {"frac": 0.03, "seed": 5},
+         "blackout": {"frac": 0.08, "seed": 9}, "loss": 0.1},
+    ]})
+    sc = compile_scenario(spec, n_peers=1500, n_slots=plan.n,
+                          total_rounds=8, node_map=rows_of)
+    strm = compile_stream(rate=1.5, msg_slots=8, ttl=12,
+                          origin_rows=rows_of(np.arange(1500)))
+    ctl = compile_control(target_ratio=0.9, fanout=2, lo=1, hi=4, ttl=12)
+    q = compile_quorum(3, window=4, budget=2)
+    pipe = compile_pipeline(1)
+    fin_l, stats_l = simulate(clone_state(st), cfg, 6, plan, "fused", sc,
+                              None, strm, ctl, pipe, q)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(st, mesh), cfg, plan_m, mesh, 6, None, sc,
+        stream=strm, control=ctl, pipeline=pipe, liveness=q,
+    )
+    for f in dataclasses.fields(fin_l):
+        la, lb = getattr(fin_l, f.name), getattr(fin_d, f.name)
+        if jnp.issubdtype(la.dtype, jax.dtypes.prng_key):
+            la, lb = jax.random.key_data(la), jax.random.key_data(lb)
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb), err_msg=f.name
+        )
+    for f in stats_l._fields:
+        a = np.asarray(getattr(stats_l, f))
+        if a.dtype.kind in "biu":
+            np.testing.assert_array_equal(
+                a, np.asarray(getattr(stats_d, f)), err_msg=f
+            )
+    assert int(np.asarray(stats_l.adv_accusations).sum()) > 0
 
 
 def test_bucketed_scenario_kernel_receive_parity(setup):

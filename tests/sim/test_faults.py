@@ -495,12 +495,19 @@ def test_cli_rejects_invalid_scenario(tmp_path, capsys):
 
 def test_catalogued_scenarios_parse_and_validate():
     """Every scenario shipped in scenarios/ must parse and fit the smoke
-    horizon CI runs them under (.github/workflows/ci.yml)."""
+    horizon CI runs them under — the catalogue-smoke campaign's [base]
+    rounds (scenarios/campaigns/catalogue_smoke.toml), read here so the
+    pin tracks the campaign instead of a hand-copied constant."""
     import pathlib
 
+    from tpu_gossip.fleet import parse_campaign
+
     root = pathlib.Path(__file__).resolve().parents[2] / "scenarios"
+    horizon = int(parse_campaign(
+        root / "campaigns" / "catalogue_smoke.toml"
+    ).base["rounds"])
     files = sorted(root.glob("*.toml"))
     assert len(files) >= 4, "the scenario catalogue shrank"
     for f in files:
         spec = parse_scenario(f)
-        spec.validate(total_rounds=30, n_peers=96)
+        spec.validate(total_rounds=horizon, n_peers=96)

@@ -56,6 +56,8 @@ def test_jsonl_rows(setup):
         "stream_expired", "slot_infected", "slot_age",
         "control_level", "control_fanout", "msgs_duplicate",
         "control_refreshed",
+        "evictions_new", "false_evictions", "n_quarantined",
+        "dead_undeclared", "adv_accusations", "adv_forged",
     }
     # the streaming plane's per-slot tracks emit as JSON lists (one entry
     # per dedup slot); scalars stay scalars — and an unloaded run's

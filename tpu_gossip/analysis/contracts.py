@@ -122,6 +122,15 @@ def _stats_contract(stats, problems: list, leading=(), msg_slots=None) -> None:
         "control_fanout": (jnp.int32, ()),
         "msgs_duplicate": (jnp.int32, ()),
         "control_refreshed": (jnp.int32, ()),
+        # hardened-liveness / adversarial track (kernels/liveness.py):
+        # eviction precision/recall numerators, the quarantine census,
+        # and the attack plane's emission counters — all scalar int32
+        "evictions_new": (jnp.int32, ()),
+        "false_evictions": (jnp.int32, ()),
+        "n_quarantined": (jnp.int32, ()),
+        "dead_undeclared": (jnp.int32, ()),
+        "adv_accusations": (jnp.int32, ()),
+        "adv_forged": (jnp.int32, ()),
     }
     for field, (dt, trailing) in declared.items():
         leaf = getattr(stats, field, None)

@@ -140,6 +140,41 @@ def _chaos_scenario(n_slots: int, n_real: int):
     )
 
 
+def _adversary_scenario(n_slots: int, n_real: int):
+    """A compiled scenario with every Byzantine attack class active —
+    accusers, forgers, floods — composed with a blackout (true-eviction
+    ground truth), so the adversarial round traces its full structure
+    (the accusation scatter, the forged-heartbeat scatter, the flood
+    replay, the quorum/quarantine state machine) under the fixed-point
+    contract."""
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+
+    spec = scenario_from_dict({
+        "name": "audit-byzantine",
+        "phases": [
+            {"name": "dark", "start": 0, "end": 2,
+             "blackout": {"frac": 0.1, "seed": 2}},
+            {"name": "siege", "start": 2, "end": 6,
+             "accusers": {"frac": 0.05, "seed": 3},
+             "forgers": {"frac": 0.02, "seed": 4},
+             "floods": {"frac": 0.03, "seed": 5},
+             "forge_fanout": 2, "flood_fanout": 3},
+        ],
+    })
+    return compile_scenario(
+        spec, n_peers=n_real, n_slots=n_slots, total_rounds=8
+    )
+
+
+def _quorum_spec():
+    """The quorum-defense spec the adversarial entries trace under —
+    active quarantine budget so the strike/release paths are in the
+    jaxpr."""
+    from tpu_gossip.kernels.liveness import compile_quorum
+
+    return compile_quorum(quorum_k=3, window=4, budget=2)
+
+
 def _growth_plan(n_slots: int, n_initial: int):
     """A small compiled growth schedule so the growing round traces its
     full structure (admission slice, Gumbel-top-k draw, registry
@@ -479,6 +514,55 @@ def _local_entries() -> list[EntryPoint]:
         n_peers=ctx["dg"].n_pad,
     ))
 
+    # the ADVERSARIAL round (faults/ Byzantine plane + kernels/liveness.py
+    # quorum machine): accusation/forgery/flood scatters and the
+    # suspicion/quarantine planes must keep the round a state fixed point
+    # — the new planes ride scan/while carries and checkpoints
+    def build_adv():
+        st, cfg = ctx["state_for"](
+            ctx["dg"], 16, mode="push_pull", rewire_slots=2,
+            churn_join_prob=0.02, churn_leave_prob=0.002,
+        )
+        sc = _adversary_scenario(ctx["dg"].n_pad, _N_DEV)
+        q = _quorum_spec()
+        return (
+            lambda s: engine.gossip_round(s, cfg, scenario=sc, liveness=q),
+            st,
+        )
+
+    eps.append(EntryPoint(
+        name="local[xla,adversary]", engine="xla", kind="round",
+        audit_check="gossip_round_local", build=build_adv,
+        n_peers=ctx["dg"].n_pad,
+    ))
+
+    # the maximal composed cell: adversary × scenario × growth × stream ×
+    # control — FIVE parallel fold_in streams beside the protocol's
+    # 5-way split, the widest salt-collision surface the deep lineage
+    # pass audits
+    def build_all_five():
+        st, cfg = ctx["state_for"](
+            ctx["dg"], 16, mode="push_pull", rewire_slots=2,
+            churn_join_prob=0.02, churn_leave_prob=0.002,
+        )
+        sc = _adversary_scenario(ctx["dg"].n_pad, _N_DEV)
+        gp = _growth_plan(ctx["dg"].n_pad, ctx["dg"].n_pad - 40)
+        sp = _stream_plan(16, ctx["dg"].exists)
+        cp = _control_plan(ttl=8)
+        q = _quorum_spec()
+        return (
+            lambda s: engine.gossip_round(s, cfg, scenario=sc, growth=gp,
+                                          stream=sp, control=cp,
+                                          liveness=q),
+            st,
+        )
+
+    eps.append(EntryPoint(
+        name="local[xla,scenario+growth+stream+control+adversary]",
+        engine="xla", kind="round", audit_check="gossip_round_local",
+        build=build_all_five, n_peers=ctx["dg"].n_pad,
+    ))
+
     # the jitted loop entries (donating: state aliases the carry)
     def build_sim():
         st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
@@ -580,6 +664,12 @@ def _dist_entries() -> list[EntryPoint]:
                     plan.n if eng == "dist-matching" else sg.n_pad,
                     _N_MATCH if eng == "dist-matching" else _N_DEV,
                 )
+            if kw.pop("adversary", False):
+                kw["scenario"] = _adversary_scenario(
+                    plan.n if eng == "dist-matching" else sg.n_pad,
+                    _N_MATCH if eng == "dist-matching" else _N_DEV,
+                )
+                kw["liveness"] = _quorum_spec()
             if "growth" in kw and kw["growth"] is True:
                 n_slots = plan.n if eng == "dist-matching" else sg.n_pad
                 kw["growth"] = _growth_plan(n_slots, n_slots - 40)
@@ -640,6 +730,14 @@ def _dist_entries() -> list[EntryPoint]:
     eps.append(dist_ep(
         "dist[matching,stream]", "dist-matching", "gossip_round_dist",
         {}, dict(stream=True),
+    ))
+    # the ADVERSARIAL mesh round: the Byzantine scatters and the quorum
+    # machine run at global shape outside shard_map — the adversarial
+    # extension of the bit-identity contract must trace with the same
+    # fixed point the local adversarial round keeps
+    eps.append(dist_ep(
+        "dist[matching,adversary+scenario]", "dist-matching",
+        "gossip_round_dist", {}, dict(adversary=True),
     ))
     eps.append(dist_ep(
         "dist[bucketed]", "dist-bucketed", "gossip_round_dist", {}, {},

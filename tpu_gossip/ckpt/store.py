@@ -405,7 +405,10 @@ def load_checkpoint(path, *, lane: int | None = None,
     kind = manifest.get("kind", "run")
 
     def build_solo(arrays: dict, source: str) -> SwarmState:
+        from tpu_gossip.core.state import zero_suspicion
+
         kwargs = {}
+        suspicion = ("suspect_round", "suspect_mark", "quarantine")
         for f in dataclasses.fields(SwarmState):
             if f"prngkey_{f.name}" in arrays:
                 kwargs[f.name] = jax.random.wrap_key_data(
@@ -413,11 +416,29 @@ def load_checkpoint(path, *, lane: int | None = None,
                 )
             elif f"field_{f.name}" in arrays:
                 kwargs[f.name] = jnp.asarray(arrays[f"field_{f.name}"])
+            elif f.name in suspicion:
+                continue  # pre-adversarial checkpoint: filled below
             else:
                 raise CheckpointError(
                     f"{source}: plane {f.name!r} missing from the "
                     "checkpoint — foreign or pre-format file"
                 )
+        absent = [p for p in suspicion if p not in kwargs]
+        if len(absent) == len(suspicion):
+            # checkpoints written before the quorum-defense planes load
+            # with them zeroed — no suspicion in flight, no strikes,
+            # nobody quarantined: exactly their semantics when saved
+            kwargs.update(zero_suspicion(kwargs["exists"].shape[0]))
+        elif absent:
+            # a PARTIAL subset is not a pre-format file — it is a torn or
+            # foreign checkpoint; zero-filling would silently drop the
+            # planes that ARE stored
+            raise CheckpointError(
+                f"{source}: suspicion plane(s) {absent} missing while "
+                f"{sorted(set(suspicion) - set(absent))} are present — "
+                "torn or foreign checkpoint (a pre-adversarial file "
+                "carries none of the three)"
+            )
         kwargs = cast_to_declared(kwargs)
         state = SwarmState(**kwargs)
         validate_state_planes(state, source=source)

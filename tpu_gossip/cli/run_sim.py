@@ -230,6 +230,35 @@ def build_parser() -> argparse.ArgumentParser:
         "(--rewire-slots/--grow); 0 = off",
     )
     p.add_argument(
+        "--quorum-k", type=int, default=None, metavar="K",
+        help="harden the failure detector into the witness-quorum "
+        "suspicion machine (kernels/liveness.py, docs/"
+        "adversarial_model.md): a stale peer is only SUSPECTED, and "
+        "declared dead after K distinct witness confirmations inside the "
+        "suspicion window. K=1 degrades to the reference's single-report "
+        "purge (bit-identical to the unhardened detector with no "
+        "adversaries); K>1 defends against Byzantine accusers — a "
+        "scenario with accusers/forgers/floods phases REQUIRES this "
+        "flag. The summary JSON gains a `liveness` block (evictions, "
+        "false evictions, precision, quarantined count)",
+    )
+    p.add_argument(
+        "--suspicion-window", type=int, default=None, metavar="W",
+        help="rounds a suspicion may accumulate witness votes before it "
+        "expires without quorum (default: 2x the detector sweep period). "
+        "Must be at least the sweep period — the PING grace — or a "
+        "suspicion would expire before its probe could refute. Needs "
+        "--quorum-k",
+    )
+    p.add_argument(
+        "--accusation-budget", type=int, default=None, metavar="B",
+        help="false accusations (victim refutes inside the window) a "
+        "peer may emit before the quarantine verdict latches: its sends "
+        "are masked, its accusations ignored, its rewire slots released "
+        "through the degree-credit book (default 3; 0 disables "
+        "quarantine). Needs --quorum-k",
+    )
+    p.add_argument(
         "--scenario", type=str, default="", metavar="TOML",
         help="chaos scenario schedule (tpu_gossip/faults/, docs/"
         "fault_model.md): time-phased message loss, delivery delay, "
@@ -374,6 +403,10 @@ def _run(args, resume=None) -> int:
     if control_err:
         print(control_err, file=sys.stderr)
         return 2
+    liveness_err = _validate_liveness(args, spec)
+    if liveness_err:
+        print(liveness_err, file=sys.stderr)
+        return 2
     ckpt_err = _validate_ckpt(args)
     if ckpt_err:
         print(ckpt_err, file=sys.stderr)
@@ -517,17 +550,19 @@ def _run(args, resume=None) -> int:
         else np.arange(graph.n),
     )
     ctl = _compile_cli_control(args)
+    lqs = _compile_cli_liveness(args)
     policy = _ckpt_policy(args, shards=1)
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_with_remat(args, cfg, state, scen, grow,
-                                           strm, ctl, policy=policy,
+                                           strm, ctl, lqs, policy=policy,
                                            resume=resume)
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
             if policy is None and resume is None:
                 fin, stats = simulate(state, cfg, args.rounds, plan,
-                                      args.tail, scen, grow, strm, ctl)
+                                      args.tail, scen, grow, strm, ctl,
+                                      None, lqs)
             else:
                 from tpu_gossip.ckpt import host_stats, run_checkpointed
 
@@ -535,7 +570,7 @@ def _run(args, resume=None) -> int:
 
                 def seg_run(st, seg):
                     st, s = simulate(st, cfg, seg, plan, args.tail, scen,
-                                     grow, strm, ctl)
+                                     grow, strm, ctl, None, lqs)
                     return st, host_stats(s)
 
                 fin, sd = run_checkpointed(
@@ -548,10 +583,11 @@ def _run(args, resume=None) -> int:
             summary = _horizon_summary(args, stats,
                                        **_scenario_summary(spec, stats),
                                        **_stream_summary(args, cfg, stats),
-                                       **_control_summary(args, cfg, stats))
+                                       **_control_summary(args, cfg, stats),
+                                       **_liveness_summary(args, stats))
             summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
-            if scen is None and grow is None and ctl is None:
+            if scen is None and grow is None and ctl is None and lqs is None:
                 result, fin = M.bench_swarm(
                     state, cfg, args.target, args.max_rounds, plan=plan,
                     tail=args.tail,
@@ -564,12 +600,13 @@ def _run(args, resume=None) -> int:
                     run=lambda st: run_until_coverage(
                         st, cfg, args.target, args.max_rounds, plan=plan,
                         tail=args.tail, scenario=scen, growth=grow,
-                        control=ctl,
+                        control=ctl, liveness=lqs,
                     ),
                 )
             summary = {"summary": True, "mode": args.mode,
                        **_scenario_summary(spec),
                        **_control_summary(args),
+                       **_liveness_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
@@ -697,7 +734,7 @@ def _main_fleet(argv: list[str]) -> int:
         def seg_run(st, seg):
             st, s = fleet.simulate_fleet(
                 st, camp.cfg, seg, camp.scenario, camp.growth,
-                camp.stream, camp.control,
+                camp.stream, camp.control, camp.liveness,
             )
             return st, host_stats(s)
 
@@ -721,7 +758,7 @@ def _main_fleet(argv: list[str]) -> int:
     # call cache is not populated by AOT compilation)
     compiled = fleet.simulate_fleet.lower(
         camp.states, camp.cfg, camp.rounds, camp.scenario, camp.growth,
-        camp.stream, camp.control,
+        camp.stream, camp.control, camp.liveness,
     ).compile()
     t0 = _time.perf_counter()
     # the donating path: the CLI never touches camp.states again (lane
@@ -944,7 +981,7 @@ def _resume_fleet(rargs, path, manifest) -> int:
         _st0, sc, gr, sp, cp = camp.lane(rargs.lane)
         remaining = camp.rounds - int(np.asarray(st.round))
         fin, _stats = simulate(st, camp.cfg, remaining, None, "fused",
-                               sc, gr, sp, cp)
+                               sc, gr, sp, cp, None, camp.liveness)
         print(json.dumps({
             "summary": True, "fleet": "solo-resume",
             "campaign": camp.name, "lane": rargs.lane,
@@ -975,7 +1012,7 @@ def _resume_fleet(rargs, path, manifest) -> int:
     def seg_run(st, seg):
         st, s = fleet.simulate_fleet(
             st, camp.cfg, seg, camp.scenario, camp.growth, camp.stream,
-            camp.control,
+            camp.control, camp.liveness,
         )
         return st, host_stats(s)
 
@@ -1154,6 +1191,91 @@ def _validate_control(args):
                 "(rewire_targets) — only re-wired peers carry swappable "
                 "fresh edges; add --rewire-slots (with churn) or --grow")
     return None
+
+
+def _validate_liveness(args, spec):
+    """Normalize + reject impossible --quorum-k configs; returns an error
+    string (exit 2) or None. Mutates args: fills the window/budget
+    defaults so every engine path reads one settled config — the
+    hardened-detector twin of :func:`_validate_grow`."""
+    from tpu_gossip.core.state import SwarmConfig
+    from tpu_gossip.kernels.liveness import (
+        SUSPECT_STRIKE_CAP, SUSPECT_VOTE_CAP,
+    )
+
+    sweep = SwarmConfig.__dataclass_fields__["detect_period_rounds"].default
+    if args.quorum_k is None:
+        set_flags = [
+            name for name, dflt in (
+                ("--suspicion-window", args.suspicion_window is None),
+                ("--accusation-budget", args.accusation_budget is None),
+            ) if not dflt
+        ]
+        if set_flags:
+            return (f"{set_flags[0]} shapes the quorum failure detector; "
+                    "add --quorum-k K")
+        if spec is not None and spec.uses_adversaries:
+            return ("--scenario: Byzantine adversary phases (accusers/"
+                    "forgers/floods) need the quorum-defense planes; add "
+                    "--quorum-k K (K=1 reproduces the reference's "
+                    "single-report purge — the unhardened baseline)")
+        return None
+    if args.quorum_k < 1:
+        return (f"--quorum-k {args.quorum_k} must be >= 1 — at least one "
+                "witness must confirm a suspicion (K=1 is the reference's "
+                "single-report behavior)")
+    if args.quorum_k > SUSPECT_VOTE_CAP:
+        return (f"--quorum-k {args.quorum_k} exceeds the packed vote "
+                f"counter's cap ({SUSPECT_VOTE_CAP})")
+    if args.suspicion_window is None:
+        args.suspicion_window = 2 * sweep
+    if args.suspicion_window < sweep:
+        return (f"--suspicion-window {args.suspicion_window} is shorter "
+                f"than the detector sweep period ({sweep} rounds — the "
+                "PING grace): a suspicion would expire before its probe "
+                "could refute it")
+    if args.accusation_budget is None:
+        args.accusation_budget = 3
+    if not 0 <= args.accusation_budget <= SUSPECT_STRIKE_CAP:
+        return (f"--accusation-budget {args.accusation_budget} outside "
+                f"[0, {SUSPECT_STRIKE_CAP}] (the packed strike counter's "
+                "range; 0 disables quarantine)")
+    if args.profile_round > 0:
+        return ("--profile-round measures the unhardened round's stage "
+                "decomposition; drop --quorum-k")
+    return None
+
+
+def _compile_cli_liveness(args):
+    """Compile the --quorum-k detector spec — jit-static, so ONE spec
+    serves every engine path (and every fleet lane)."""
+    if args.quorum_k is None:
+        return None
+    from tpu_gossip.kernels.liveness import compile_quorum
+
+    return compile_quorum(
+        quorum_k=args.quorum_k,
+        window=args.suspicion_window,
+        budget=args.accusation_budget,
+    )
+
+
+def _liveness_summary(args, stats=None) -> dict:
+    """Summary-row hardened-detector fields: the quorum config plus,
+    when per-round stats exist, the eviction/quarantine report
+    (sim.metrics.liveness_report)."""
+    if args.quorum_k is None:
+        return {}
+    out = {"liveness": {
+        "quorum_k": args.quorum_k,
+        "suspicion_window": args.suspicion_window,
+        "accusation_budget": args.accusation_budget,
+    }}
+    if stats is not None:
+        from tpu_gossip.sim import metrics as M
+
+        out["liveness"].update(M.liveness_report(stats))
+    return out
 
 
 def _validate_ckpt(args):
@@ -1589,7 +1711,7 @@ def _main_profile_round(args, cfg, state, plan, grow=None, strm=None,
 
 
 def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
-                    ctl=None, policy=None, resume=None):
+                    ctl=None, lqs=None, policy=None, resume=None):
     """Segmented run: R rounds → fold fresh edges into the CSR → repeat.
 
     The first re-materialization pads col_idx to the fixed capacity, so the
@@ -1651,7 +1773,7 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
 
         def seg_run(st, seg):
             st, s = simulate(st, cfg, seg, seg_plan(st), args.tail, scen,
-                             grow, strm, ctl)
+                             grow, strm, ctl, None, lqs)
             return st, host_stats(s)
 
         t0 = _time.perf_counter()
@@ -1674,6 +1796,7 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
             wall_seconds=wall,
             **_stream_summary(args, cfg, stats),
             **_control_summary(args, cfg, stats),
+            **_liveness_summary(args, stats),
         )
         summary.update(_digest_summary(args, fin, stats, policy, resume))
         return summary, fin
@@ -1681,10 +1804,11 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
     def run_segment(st, seg, plan):
         if args.rounds > 0:
             return simulate(st, cfg, seg, plan, args.tail, scen, grow, strm,
-                            ctl)
+                            ctl, None, lqs)
         return run_until_coverage(
             st, cfg, args.target, seg, plan=plan, tail=args.tail,
             scenario=scen, growth=grow, stream=strm, control=ctl,
+            liveness=lqs,
         ), None
 
     # warm EVERY shape the timed loop will see, on throwaway clones:
@@ -1736,6 +1860,7 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
         summary = _horizon_summary(
             args, stats, **extra, **_stream_summary(args, cfg, stats),
             **_control_summary(args, cfg, stats),
+            **_liveness_summary(args, stats),
         )
         summary.update(_digest_summary(args, state, stats))
         return summary, state
@@ -1748,6 +1873,7 @@ def _run_with_remat(args, cfg, state, scen=None, grow=None, strm=None,
         "coverage": float(state.coverage(0)),
         "ms_per_round": wall / max(rounds, 1) * 1000.0,
         **extra,
+        **_liveness_summary(args),
     }
     return summary, state
 
@@ -1780,7 +1906,8 @@ def _horizon_summary(args, stats, **extra):
 
 
 def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
-                          ctl=None, pipe=None, policy=None, resume=None):
+                          ctl=None, pipe=None, lqs=None, policy=None,
+                          resume=None):
     """The mesh epoch loop (SURVEY.md §7.4's full churn lifecycle):
 
         R churned rounds -> fold fresh edges into the CSR
@@ -1853,7 +1980,7 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
             st, s = simulate_dist(
                 st, cfg, nonstate["sg"], mesh, seg, nonstate["plans"],
                 scen, None, nonstate["transport"], control=ctl,
-                pipeline=pipe,
+                pipeline=pipe, liveness=lqs,
             )
             return st, host_stats(s)
 
@@ -1870,6 +1997,7 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
             args, stats, devices=mesh.size, remat_every=r,
             remats=(total - 1) // r, wall_seconds=wall,
             **_control_summary(args, cfg, stats),
+            **_liveness_summary(args, stats),
         )
         summary.update(_digest_summary(args, fin, stats, policy, resume))
         return summary, fin
@@ -1882,12 +2010,12 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
     if args.rounds > 0:
         warm = simulate_dist(clone_state(state), cfg, sg, mesh, seg0, plans,
                              scen, None, transport, control=ctl,
-                             pipeline=pipe)[0]
+                             pipeline=pipe, liveness=lqs)[0]
     else:
         warm = run_until_coverage_dist(
             clone_state(state), cfg, sg, mesh, args.target, seg0,
             shard_plan=plans, scenario=scen, transport=transport,
-            control=ctl, pipeline=pipe,
+            control=ctl, pipeline=pipe, liveness=lqs,
         )
     float(warm.coverage(0))
     del warm
@@ -1898,13 +2026,13 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
         if args.rounds > 0:
             state, stats = simulate_dist(state, cfg, sg, mesh, seg, plans,
                                          scen, None, transport, control=ctl,
-                                         pipeline=pipe)
+                                         pipeline=pipe, liveness=lqs)
             stats_parts.append(stats)
         else:
             state = run_until_coverage_dist(
                 state, cfg, sg, mesh, args.target, seg, shard_plan=plans,
                 scenario=scen, transport=transport, control=ctl,
-                pipeline=pipe,
+                pipeline=pipe, liveness=lqs,
             )
             if float(state.coverage(0)) >= args.target:
                 break
@@ -1937,7 +2065,8 @@ def _run_shard_with_remat(args, cfg, state, sg, mesh, plans, scen=None,
         if not args.quiet:
             M.write_jsonl(stats, sys.stdout)
         summary = _horizon_summary(
-            args, stats, **extra, **_control_summary(args, cfg, stats)
+            args, stats, **extra, **_control_summary(args, cfg, stats),
+            **_liveness_summary(args, stats),
         )
         summary.update(_digest_summary(args, state, stats))
         return summary, state
@@ -2098,6 +2227,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
     strm = _compile_cli_stream(args, to_rows(np.arange(args.peers)))
     ctl = _compile_cli_control(args)
+    lqs = _compile_cli_liveness(args)
     pipe = _compile_cli_pipeline(args)
     policy = _ckpt_policy(args, shards=n_build, extra={"devices": n_build})
     with trace(args.profile):
@@ -2106,13 +2236,14 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                 if transport is not None:
                     fin, (stats, ici) = simulate_dist(
                         state, cfg, plan, mesh, args.rounds, None, scen,
-                        grow, transport, True, strm, ctl, pipe,
+                        grow, transport, True, strm, ctl, pipe, lqs,
                     )
                 else:
                     fin, stats = simulate_dist(state, cfg, plan, mesh,
                                                args.rounds, None, scen,
                                                grow, stream=strm,
-                                               control=ctl, pipeline=pipe)
+                                               control=ctl, pipeline=pipe,
+                                               liveness=lqs)
                     ici = None
             else:
                 from tpu_gossip.ckpt import host_stats, run_checkpointed
@@ -2132,17 +2263,18 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                 def seg_run(st, seg):
                     if local:
                         st, s = simulate(st, cfg, seg, plan, "fused", scen,
-                                         grow, strm, ctl, pipe)
+                                         grow, strm, ctl, pipe, lqs)
                         return st, host_stats(s)
                     if transport is not None:
                         st, (s, seg_ici) = simulate_dist(
                             st, cfg, plan, mesh, seg, None, scen, grow,
-                            transport, True, strm, ctl, pipe,
+                            transport, True, strm, ctl, pipe, lqs,
                         )
                         return st, host_stats(s, seg_ici)
                     st, s = simulate_dist(st, cfg, plan, mesh, seg, None,
                                           scen, grow, stream=strm,
-                                          control=ctl, pipeline=pipe)
+                                          control=ctl, pipeline=pipe,
+                                          liveness=lqs)
                     return st, host_stats(s)
 
                 fin, sd = run_checkpointed(
@@ -2159,6 +2291,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                 **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
+                **_liveness_summary(args, stats),
             )
             summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
@@ -2171,7 +2304,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                 return run_until_coverage_dist(
                     st, cfg, plan, mesh, args.target, args.max_rounds,
                     scenario=scen, growth=grow, transport=transport,
-                    control=ctl, pipeline=pipe,
+                    control=ctl, pipeline=pipe, liveness=lqs,
                 )
 
             r0 = int(state.round)
@@ -2187,6 +2320,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                 _, (_stats, ici) = simulate_dist(
                     clone_state(state), cfg, plan, mesh, rounds, None, scen,
                     grow, transport, True, control=ctl, pipeline=pipe,
+                    liveness=lqs,
                 )
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
@@ -2194,6 +2328,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                        **_transport_summary(args, ici, rounds),
                        **_pipeline_summary(args),
                        **_control_summary(args),
+                       **_liveness_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))
@@ -2268,6 +2403,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     )
     strm = _compile_cli_stream(args, position[np.arange(args.peers)])
     ctl = _compile_cli_control(args)
+    lqs = _compile_cli_liveness(args)
     pipe = _compile_cli_pipeline(args)
     policy = _ckpt_policy(args, shards=mesh.size,
                           extra={"devices": mesh.size})
@@ -2275,7 +2411,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
-                args, cfg, state, sg, mesh, plans, scen, ctl, pipe,
+                args, cfg, state, sg, mesh, plans, scen, ctl, pipe, lqs,
                 policy=policy, resume=resume,
             )
             summary.update(_scenario_summary(spec))
@@ -2287,13 +2423,14 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                 if transport is not None:
                     fin, (stats, ici) = simulate_dist(
                         state, cfg, sg, mesh, args.rounds, plans, scen, grow,
-                        transport, True, strm, ctl, pipe,
+                        transport, True, strm, ctl, pipe, lqs,
                     )
                 else:
                     fin, stats = simulate_dist(state, cfg, sg, mesh,
                                                args.rounds, plans, scen,
                                                grow, stream=strm,
-                                               control=ctl, pipeline=pipe)
+                                               control=ctl, pipeline=pipe,
+                                               liveness=lqs)
                     ici = None
             else:
                 from tpu_gossip.ckpt import host_stats, run_checkpointed
@@ -2307,12 +2444,13 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                     if transport is not None:
                         st, (s, seg_ici) = simulate_dist(
                             st, cfg, sg, mesh, seg, plans, scen, grow,
-                            transport, True, strm, ctl, pipe,
+                            transport, True, strm, ctl, pipe, lqs,
                         )
                         return st, host_stats(s, seg_ici)
                     st, s = simulate_dist(st, cfg, sg, mesh, seg, plans,
                                           scen, grow, stream=strm,
-                                          control=ctl, pipeline=pipe)
+                                          control=ctl, pipeline=pipe,
+                                          liveness=lqs)
                     return st, host_stats(s)
 
                 fin, sd = run_checkpointed(
@@ -2329,6 +2467,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                 **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
+                **_liveness_summary(args, stats),
             )
             summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
@@ -2343,6 +2482,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                     st, cfg, sg, mesh, args.target, args.max_rounds,
                     shard_plan=plans, scenario=scen, growth=grow,
                     transport=transport, control=ctl, pipeline=pipe,
+                    liveness=lqs,
                 )
 
             r0 = int(state.round)
@@ -2358,12 +2498,14 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                 _, (_stats, ici) = simulate_dist(
                     clone_state(state), cfg, sg, mesh, rounds, plans, scen,
                     grow, transport, True, control=ctl, pipeline=pipe,
+                    liveness=lqs,
                 )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
                        **_scenario_summary(spec),
                        **_transport_summary(args, ici, rounds),
                        **_pipeline_summary(args),
                        **_control_summary(args),
+                       **_liveness_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     print(json.dumps(summary))

@@ -54,6 +54,7 @@ __all__ = [
     "message_slot",
     "message_slots",
     "saturate_round",
+    "zero_suspicion",
     "validate_state_planes",
     "save_swarm",
     "load_swarm",
@@ -140,6 +141,15 @@ PLANES: tuple[PlaneSpec, ...] = (
               "level index into a tiny fanout table; scalar — narrowing "
               "saves nothing"),
     PlaneSpec("pipe_buf", "bool", "(N, M)", 1, "in-flight delivery bit"),
+    PlaneSpec("suspect_round", "int16", "(N,)", 16,
+              "round numbers: -1 or the suspicion-entry round <= ROUND_CAP "
+              "(saturate_round at the latch site)"),
+    PlaneSpec("suspect_mark", "int16", "(N,)", 15,
+              "packed witness-count: confirmation votes (low 8 bits, "
+              "saturating at SUSPECT_VOTE_CAP=255) + false-accusation "
+              "strikes (high 7 bits, saturating at SUSPECT_STRIKE_CAP="
+              "127) — max packed value 32767 fits int16 exactly"),
+    PlaneSpec("quarantine", "bool", "(N,)", 1, "Byzantine-verdict bit"),
     PlaneSpec("rng", "key", "()", 64, "threefry key (2x uint32)"),
     PlaneSpec("round", "int32", "()", 16, "scalar round cursor"),
 )
@@ -340,6 +350,24 @@ class SwarmState:
     # checkpoints that predate the field load with it empty, which is
     # also a pipelined run's cold-start state (round 1 delivers nothing).
     pipe_buf: jax.Array  # bool (N, M)
+    # quorum-suspicion liveness plane (kernels/liveness.py QuorumSpec,
+    # docs/adversarial_model.md): the hardened detector's alive →
+    # suspected → dead state machine. ``suspect_round`` is the round a
+    # peer entered suspicion (-1 = not suspected); ``suspect_mark`` packs
+    # the suspicion's witness-confirmation votes with the peer's
+    # false-accusation strikes (pack_suspicion/unpack_suspicion);
+    # ``quarantine`` latches when a repeat false accuser crosses the
+    # accusation budget — its sends are masked and its rewire slots
+    # released through the degree-credit book balance. Together these are
+    # the checkpointable SUSPICION CURSOR: a mid-suspicion checkpoint
+    # resumes bit-exactly under the same QuorumSpec. The legacy detector
+    # path (liveness=None) carries all three untouched — an unhardened
+    # run never pays for them — and checkpoints that predate the planes
+    # load with them zeroed (no suspicion, no strikes, nobody
+    # quarantined: exactly their semantics when saved).
+    suspect_round: jax.Array  # int16 (N,) — -1 or entry round (<= ROUND_CAP per the PLANES registry)
+    suspect_mark: jax.Array  # int16 (N,) — packed votes + strikes
+    quarantine: jax.Array  # bool (N,) — accusation-budget verdict
     # bookkeeping
     rng: jax.Array  # PRNG key
     round: jax.Array  # int32 scalar
@@ -408,7 +436,8 @@ def load_swarm(path) -> SwarmState:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))
             elif (
                 f.name in ("fault_held", "slot_lease", "control_lvl",
-                           "pipe_buf")
+                           "pipe_buf", "suspect_round", "suspect_mark",
+                           "quarantine")
                 or f.name in _GROWTH_FIELDS
             ) and f"field_{f.name}" not in data:
                 continue  # pre-scenario/growth/stream/control checkpoint:
@@ -429,6 +458,12 @@ def load_swarm(path) -> SwarmState:
             # pre-pipeline checkpoint: empty in-flight buffer — exactly a
             # pipelined run's cold start (round 1 delivers nothing)
             kwargs["pipe_buf"] = jnp.zeros(kwargs["seen"].shape, dtype=bool)
+        # pre-adversarial-plane checkpoint: each missing suspicion plane
+        # loads zeroed (no suspicion in flight, no strikes, nobody
+        # quarantined — the legacy detector had no suspicion state);
+        # setdefault so a plane that IS stored is never overwritten
+        for name, leaf in zero_suspicion(kwargs["exists"].shape[0]).items():
+            kwargs.setdefault(name, leaf)
     else:  # legacy positional layout
         for i, name in enumerate(_V1_FIELDS):
             if f"key_{i}" in data:
@@ -455,6 +490,7 @@ def load_swarm(path) -> SwarmState:
         kwargs["slot_lease"] = _implied_leases(kwargs["seen"])
         kwargs["control_lvl"] = jnp.asarray(-1, dtype=jnp.int32)
         kwargs["pipe_buf"] = jnp.zeros((n, m), dtype=bool)
+        kwargs.update(zero_suspicion(n))
     kwargs = cast_to_declared(kwargs)
     state = SwarmState(**kwargs)
     validate_state_planes(state, source=str(path))
@@ -545,6 +581,20 @@ def _implied_leases(seen: jax.Array) -> jax.Array:
     as aged round-0 leases, so a TTL shorter than the checkpoint's round
     recycles them promptly instead of conflating new traffic into them."""
     return jnp.where(jnp.any(seen, axis=0), 0, -1).astype(jnp.int16)
+
+
+def zero_suspicion(n: int) -> dict:
+    """The suspicion plane a pre-adversarial checkpoint implies — and a
+    fresh swarm's cold start: no peer suspected (suspect_round -1), zero
+    witness votes and accusation strikes packed into ``suspect_mark``,
+    nobody quarantined. Shared by ``init_swarm``, ``load_swarm``, and the
+    sharded checkpoint loader (ckpt/store.py) so the three defaults can
+    never drift."""
+    return {
+        "suspect_round": jnp.full((n,), -1, dtype=jnp.int16),
+        "suspect_mark": jnp.zeros((n,), dtype=jnp.int16),
+        "quarantine": jnp.zeros((n,), dtype=bool),
+    }
 
 
 def _zero_registry(exists: jax.Array) -> dict:
@@ -739,6 +789,7 @@ def init_swarm(
         slot_lease=slot_lease,
         control_lvl=jnp.asarray(-1, dtype=jnp.int32),
         pipe_buf=jnp.zeros((n, m), dtype=bool),
+        **zero_suspicion(n),
         rng=key.copy(),  # keys are always jax arrays; same ownership rule
         round=jnp.asarray(0, dtype=jnp.int32),
     )

@@ -34,6 +34,7 @@ __all__ = [
     "TRAFFIC_STREAM_SALT",
     "CONTROL_STREAM_SALT",
     "FLEET_STREAM_SALT",
+    "ADVERSARY_STREAM_SALT",
     "register_stream",
     "registered_salts",
 ]
@@ -80,14 +81,16 @@ def registered_salts() -> dict[int, str]:
 
 # the canonical stream map (keep docs/fault_model.md + docs/growth_engine.md
 # + docs/streaming_plane.md + docs/adaptive_control.md +
-# docs/fleet_campaigns.md tables in sync):
+# docs/fleet_campaigns.md + docs/adversarial_model.md tables in sync):
 #
-#   stream   salt         consumer                         draws
-#   fault    0x5CE7A510   faults/inject.py (scenarios)     loss/delay/blackout
-#   growth   0x9087A110   growth/engine.py (admission)     Gumbel-top-k targets
-#   traffic  0x7AFF1C00   traffic/engine.py (injection)    arrivals/origins/slots
-#   control  0xC0274201   control/engine.py (PeerSwap)     neighbor-refresh swaps
-#   fleet    0xF1EE7C42   fleet/plan.py (campaign lanes)   per-lane root keys
+#   stream     salt         consumer                         draws
+#   fault      0x5CE7A510   faults/inject.py (scenarios)     loss/delay/blackout
+#   growth     0x9087A110   growth/engine.py (admission)     Gumbel-top-k targets
+#   traffic    0x7AFF1C00   traffic/engine.py (injection)    arrivals/origins/slots
+#   control    0xC0274201   control/engine.py (PeerSwap)     neighbor-refresh swaps
+#   fleet      0xF1EE7C42   fleet/plan.py (campaign lanes)   per-lane root keys
+#   adversary  0xADE57A17   faults/ + sim/stages.py          accusation victims /
+#                           (Byzantine attack plane)         forge + flood targets
 FAULT_STREAM_SALT = register_stream("fault", 0x5CE7A510)
 GROWTH_STREAM_SALT = register_stream("growth", 0x9087A110)
 TRAFFIC_STREAM_SALT = register_stream("traffic", 0x7AFF1C00)
@@ -99,3 +102,10 @@ CONTROL_STREAM_SALT = register_stream("control", 0xC0274201)
 # with the same derived lane key reproduces lane k of the batch bit for
 # bit (the fleet conformance contract, tests/sim/test_fleet.py)
 FLEET_STREAM_SALT = register_stream("fleet", 0xF1EE7C42)
+# the Byzantine attack plane (ISSUE 14): one fold per round in the shared
+# round driver (sim/stages.run_protocol_round), split into the three
+# per-round children — accusation victims, forged-heartbeat targets,
+# flood-replay targets — all drawn at GLOBAL shape outside shard_map, so
+# adversarial rounds keep the local↔sharded bit-identity contract, and a
+# scenario without adversary phases never folds the stream at all
+ADVERSARY_STREAM_SALT = register_stream("adversary", 0xADE57A17)

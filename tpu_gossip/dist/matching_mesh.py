@@ -540,6 +540,7 @@ def gossip_round_dist_matching(
     stream=None,
     control=None,
     pipeline=None,
+    liveness=None,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -558,6 +559,11 @@ def gossip_round_dist_matching(
     ``stream`` (traffic/) injects the streaming workload the same way —
     a LOADED mesh round stays bit-identical to its local twin, the
     serving extension of the contract (tests/sim/test_traffic.py).
+    ``liveness`` (kernels/liveness.py QuorumSpec, static) hardens the
+    detector and enables Byzantine adversary phases — every attack draw
+    lands at global shape outside ``shard_map``, so ADVERSARIAL mesh
+    rounds stay bit-identical to their local twins too
+    (tests/sim/test_dist.py).
     ``pipeline`` (sim/stages.py, static) selects the double-buffered
     schedule: at depth 1 the transpose pipeline for THIS round's
     transmit plane is issued into ``state.pipe_buf`` while the previous
@@ -597,6 +603,7 @@ def gossip_round_dist_matching(
     out = run_protocol_round(
         state, cfg, disseminate, scenario=scenario, growth=growth,
         stream=stream, control=control, pipeline=pipeline,
+        liveness=liveness,
     )
     if not collect_ici:
         return out

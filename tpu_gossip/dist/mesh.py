@@ -913,6 +913,7 @@ def gossip_round_dist(
     stream=None,
     control=None,
     pipeline=None,
+    liveness=None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -970,7 +971,8 @@ def gossip_round_dist(
                                           transport=transport,
                                           collect_ici=collect_ici,
                                           stream=stream, control=control,
-                                          pipeline=pipeline)
+                                          pipeline=pipeline,
+                                          liveness=liveness)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -986,6 +988,7 @@ def gossip_round_dist(
     out = run_protocol_round(
         state, cfg, disseminate, scenario=scenario, growth=growth,
         stream=stream, control=control, pipeline=pipeline,
+        liveness=liveness,
     )
     if not collect_ici:
         return out
@@ -1022,7 +1025,8 @@ def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_rounds", "collect_ici", "pipeline"),
+    static_argnames=("cfg", "mesh", "num_rounds", "collect_ici", "pipeline",
+                     "liveness"),
     donate_argnames=("state",),
 )
 def simulate_dist(
@@ -1039,6 +1043,7 @@ def simulate_dist(
     stream=None,
     control=None,
     pipeline=None,
+    liveness=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -1058,7 +1063,7 @@ def simulate_dist(
     def body(carry, _):
         out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
                                 scenario, growth, transport, collect_ici,
-                                stream, control, pipeline)
+                                stream, control, pipeline, liveness)
         if collect_ici:
             nxt, stats, ici = out
             return nxt, (stats, ici)
@@ -1071,7 +1076,7 @@ def simulate_dist(
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "max_rounds", "slot", "collect_ici",
-                     "pipeline"),
+                     "pipeline", "liveness"),
     donate_argnames=("state",),
 )
 def run_until_coverage_dist(
@@ -1090,6 +1095,7 @@ def run_until_coverage_dist(
     stream=None,
     control=None,
     pipeline=None,
+    liveness=None,
 ) -> SwarmState:
     """Multi-chip run-to-coverage (lax.while_loop, no host round-trips).
 
@@ -1116,7 +1122,7 @@ def run_until_coverage_dist(
             nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                        scenario, growth, transport,
                                        stream=stream, control=control,
-                                       pipeline=pipeline)
+                                       pipeline=pipeline, liveness=liveness)
             return nxt
 
         return jax.lax.while_loop(cond_plain, body, state)
@@ -1128,7 +1134,7 @@ def run_until_coverage_dist(
         st, acc = carry
         nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                         scenario, growth, transport, True,
-                                        stream, control, pipeline)
+                                        stream, control, pipeline, liveness)
         return nxt, accumulate_ici(acc, ici)
 
     return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))

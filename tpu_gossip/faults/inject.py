@@ -80,6 +80,14 @@ class RoundFaults(NamedTuple):
     blackout: jax.Array  # bool (N,) — rows cut off from the network
     group_b: jax.Array  # bool (N,) — partition side B (False = side A)
     join_burst: jax.Array  # i32 — extra growth admissions this round (growth/)
+    # Byzantine adversaries (docs/adversarial_model.md): the ``has_*``
+    # flags are static, so absent attack classes read scalar zero
+    # placeholders consumers never touch
+    accuser: jax.Array  # bool (N,) — rows emitting false dead-verdicts
+    forger: jax.Array  # bool (N,) — rows forging third-party heartbeats
+    flooder: jax.Array  # bool (N,) — rows replaying their seen bitmaps
+    forge_fanout: jax.Array  # i32 — forged heartbeats per forger per round
+    flood_fanout: jax.Array  # i32 — replay targets per flooder per round
 
 
 class FaultTelemetry(NamedTuple):
@@ -116,13 +124,32 @@ class CompiledScenario:
     # join_burst phases. Meaningless without an active growth schedule
     # (run_sim rejects the combination at parse time).
     join_burst: jax.Array | None = None  # i32 (P+1,)
+    # Byzantine adversary tables (docs/adversarial_model.md) — None
+    # unless the matching phase key appears, so a crash-fault-only
+    # scenario's pytree (and its cost) is unchanged
+    accuser: jax.Array | None = None  # bool (P+1, N)
+    forger: jax.Array | None = None  # bool (P+1, N)
+    flooder: jax.Array | None = None  # bool (P+1, N)
+    forge_fanout: jax.Array | None = None  # i32 (P+1,)
+    flood_fanout: jax.Array | None = None  # i32 (P+1,)
     name: str = dataclasses.field(default="scenario", metadata=dict(static=True))
     has_partition: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_blackout: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_churn: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_loss_delay: bool = dataclasses.field(default=False, metadata=dict(static=True))
     has_join_burst: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_accusers: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_forgers: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    has_floods: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    max_forge_fanout: int = dataclasses.field(default=0, metadata=dict(static=True))
+    max_flood_fanout: int = dataclasses.field(default=0, metadata=dict(static=True))
     n_rounds: int = dataclasses.field(default=0, metadata=dict(static=True))
+
+    @property
+    def has_adversary(self) -> bool:
+        """Static: any Byzantine attack class present (the adversary
+        stream is folded — and the quorum planes required — only then)."""
+        return self.has_accusers or self.has_forgers or self.has_floods
 
     def at_round(self, rnd: jax.Array) -> RoundFaults:
         """The fault parameters governing round ``rnd`` (1-based, traced).
@@ -134,6 +161,8 @@ class CompiledScenario:
         """
         o = jnp.clip(rnd - 1, 0, self.phase_of_round.shape[0] - 1)
         ph = self.phase_of_round[o]
+        zb = jnp.zeros((), dtype=bool)
+        zi = jnp.zeros((), dtype=jnp.int32)
         return RoundFaults(
             loss=self.loss[ph],
             delay=self.delay[ph],
@@ -142,10 +171,18 @@ class CompiledScenario:
             burst=self.burst[ph],
             blackout=self.blackout[ph],
             group_b=self.group_b[ph],
-            join_burst=(
-                jnp.zeros((), dtype=jnp.int32)
-                if self.join_burst is None
-                else self.join_burst[ph]
+            join_burst=zi if self.join_burst is None else self.join_burst[ph],
+            # absent attack classes hand consumers a scalar placeholder
+            # they never read (the has_* flags are static) — the
+            # join_burst pattern, so absent adversaries cost nothing
+            accuser=zb if self.accuser is None else self.accuser[ph],
+            forger=zb if self.forger is None else self.forger[ph],
+            flooder=zb if self.flooder is None else self.flooder[ph],
+            forge_fanout=(
+                zi if self.forge_fanout is None else self.forge_fanout[ph]
+            ),
+            flood_fanout=(
+                zi if self.flood_fanout is None else self.flood_fanout[ph]
             ),
         )
 
@@ -162,6 +199,8 @@ def faulted_dissemination(
     k_push: jax.Array,
     k_pull: jax.Array,
     k_fault: jax.Array,
+    flood_ok: jax.Array | None = None,
+    k_flood: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, FaultTelemetry]:
     """Run one round's dissemination with the scenario's faults applied.
 
@@ -236,6 +275,33 @@ def faulted_dissemination(
         raw, msgs = deliver(transmit, transmitter, receptive, k_push, k_pull)
         recv_ok = None
 
+    if scenario.has_floods:
+        # flood attack: each active flooder replays its FULL seen bitmap
+        # at flood_fanout sampled targets — pure duplicate-replay
+        # pressure on the dedup/Bloom plane (most landed bits are
+        # already-seen, so the damage is wire cost plus a poisoned
+        # duplicate-saturation signal for the AIMD controller). Flood
+        # traffic is ordinary network traffic: it respects partition
+        # boundaries and blacked-out receivers, and the merged bits ride
+        # the same loss/delay stage below. Draws land at global shape
+        # from the adversary stream every round of a flood-carrying
+        # scenario (quiescent phases mask them — stream positions depend
+        # only on the round, the loss/delay convention).
+        n, fw = seen.shape[0], scenario.max_flood_fanout
+        tgt = jax.random.randint(k_flood, (n, fw), 0, n)
+        act = flood_ok[:, None] & (jnp.arange(fw)[None, :] < rf.flood_fanout)
+        if scenario.has_partition:
+            act = act & (rf.group_b[tgt] == rf.group_b[:, None])
+        if scenario.has_blackout:
+            act = act & ~rf.blackout[tgt]
+        payload = seen[:, None, :] & act[:, :, None]  # (N, Fw, M)
+        raw = raw | jnp.zeros_like(raw).at[tgt.reshape(-1)].max(
+            payload.reshape(n * fw, -1), mode="drop"
+        )
+        msgs = msgs + jnp.sum(
+            seen.sum(-1, dtype=jnp.int32) * act.sum(-1, dtype=jnp.int32)
+        )
+
     if scenario.has_loss_delay:
         # loss: last-hop drop on the merged delivery bitmap
         keep = jax.random.uniform(k_loss, raw.shape) >= rf.loss
@@ -284,6 +350,7 @@ def scenario_dissemination(
     k_push: jax.Array,
     k_pull: jax.Array,
     deliver: Callable,
+    k_flood: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, FaultTelemetry, RoundFaults]:
     """The whole per-round scenario head, shared by all three engines.
 
@@ -297,12 +364,27 @@ def scenario_dissemination(
     fault_held=new_held, fstats=telemetry)``. Existing in ONE place so the
     engines cannot drift: any change to the fault plumbing lands on every
     engine at once, which is what keeps the bit-identity contract honest.
+
+    ``k_flood`` is the flood-replay child of the adversary stream
+    (derived ONCE per round by the shared driver,
+    ``sim.stages.run_protocol_round`` — one ``fold_in`` per (parent,
+    salt), the lineage contract); required exactly when the scenario
+    carries flood phases.
     """
     rf = scenario.at_round(rnd)
     k_fault = jax.random.fold_in(state.rng, FAULT_STREAM_SALT)
+    flood_ok = None
+    if scenario.has_floods:
+        flood_ok = (
+            rf.flooder & state.alive & ~state.declared_dead
+            & ~state.quarantine
+        )
+        if scenario.has_blackout:
+            flood_ok = flood_ok & ~rf.blackout
     incoming, msgs, tx_eff, new_held, telem = faulted_dissemination(
         scenario, rf, deliver, transmit, transmitter, receptive,
         state.fault_held, state.seen, k_push, k_pull, k_fault,
+        flood_ok, k_flood,
     )
     return incoming, msgs, tx_eff, new_held, telem, rf
 

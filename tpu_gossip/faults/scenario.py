@@ -184,6 +184,21 @@ class FaultPhase:
     # active growth schedule's rate — composes churn storms with growth
     # bursts. Requires a growing run (run_sim rejects it without --grow).
     join_burst: int = 0
+    # Byzantine adversaries (docs/adversarial_model.md) — require the
+    # quorum-defense planes (run_sim rejects them without --quorum-k):
+    # ``accusers`` emit one false dead-verdict per round each against a
+    # uniformly sampled live victim (the reference's single-report purge
+    # vulnerability, Seed.py:358-406); ``forgers`` emit ``forge_fanout``
+    # forged heartbeats per round each on behalf of sampled peers,
+    # stalling detection of the genuinely dead; ``floods`` replay each
+    # flooder's full seen bitmap at ``flood_fanout`` sampled targets per
+    # round — duplicate pressure on the dedup/Bloom plane (and on the
+    # AIMD controller's duplicate-saturation feedback).
+    accusers: NodeSet | None = None
+    forgers: NodeSet | None = None
+    floods: NodeSet | None = None
+    forge_fanout: int = 2
+    flood_fanout: int = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -208,6 +223,33 @@ class ScenarioSpec:
         return any(p.join_burst for p in self.phases)
 
     @property
+    def uses_adversaries(self) -> bool:
+        """True when any phase fields Byzantine adversaries — such
+        scenarios need the quorum-defense planes compiled in (run_sim
+        rejects them without ``--quorum-k``)."""
+        return any(
+            p.accusers is not None or p.forgers is not None
+            or p.floods is not None
+            for p in self.phases
+        )
+
+    @property
+    def max_forge_fanout(self) -> int:
+        """Static draw width for the forgery scatter (0 = no forgers)."""
+        return max(
+            (p.forge_fanout for p in self.phases if p.forgers is not None),
+            default=0,
+        )
+
+    @property
+    def max_flood_fanout(self) -> int:
+        """Static draw width for the flood scatter (0 = no floods)."""
+        return max(
+            (p.flood_fanout for p in self.phases if p.floods is not None),
+            default=0,
+        )
+
+    @property
     def uses_node_sets(self) -> bool:
         """True when any phase scopes a fault to a proper peer subset —
         such masks are fixed in the initial slot layout and do NOT survive
@@ -215,6 +257,9 @@ class ScenarioSpec:
         return any(
             p.partition is not None
             or p.blackout is not None
+            or p.accusers is not None
+            or p.forgers is not None
+            or p.floods is not None
             or (p.churn_nodes.kind != "all" and (p.churn_leave or p.churn_join))
             for p in self.phases
         )
@@ -265,6 +310,27 @@ class ScenarioSpec:
                     )
             if p.blackout is not None:
                 p.blackout.validate(n_peers, n_shards, f"{w}.blackout")
+            for adv in ("accusers", "forgers", "floods"):
+                ns = getattr(p, adv)
+                if ns is None:
+                    continue
+                ns.validate(n_peers, n_shards, f"{w}.{adv}")
+                if ns.covers_all(n_peers, n_shards):
+                    raise ScenarioError(
+                        f"{w}: {adv} covers every peer — an all-adversary "
+                        "swarm has no honest protocol left to attack "
+                        "(scope the set below the full membership)"
+                    )
+            if p.forgers is not None and p.forge_fanout < 1:
+                raise ScenarioError(
+                    f"{w}: forge_fanout={p.forge_fanout} must be >= 1 when "
+                    "the phase fields forgers"
+                )
+            if p.floods is not None and p.flood_fanout < 1:
+                raise ScenarioError(
+                    f"{w}: flood_fanout={p.flood_fanout} must be >= 1 when "
+                    "the phase fields floods"
+                )
         ordered = sorted(self.phases, key=lambda p: (p.start, p.end))
         for a, b in zip(ordered, ordered[1:]):
             if b.start < a.end:
@@ -404,6 +470,7 @@ def _node_set(v, where: str) -> NodeSet:
 _PHASE_KEYS = {
     "name", "start", "end", "loss", "delay", "churn_leave", "churn_join",
     "churn_nodes", "partition", "blackout", "join_burst",
+    "accusers", "forgers", "floods", "forge_fanout", "flood_fanout",
 }
 
 
@@ -446,6 +513,20 @@ def scenario_from_dict(d: dict) -> ScenarioSpec:
                     else _node_set(p["blackout"], f"phase {name!r}.blackout")
                 ),
                 join_burst=int(p.get("join_burst", 0)),
+                accusers=(
+                    None if p.get("accusers") is None
+                    else _node_set(p["accusers"], f"phase {name!r}.accusers")
+                ),
+                forgers=(
+                    None if p.get("forgers") is None
+                    else _node_set(p["forgers"], f"phase {name!r}.forgers")
+                ),
+                floods=(
+                    None if p.get("floods") is None
+                    else _node_set(p["floods"], f"phase {name!r}.floods")
+                ),
+                forge_fanout=int(p.get("forge_fanout", 2)),
+                flood_fanout=int(p.get("flood_fanout", 2)),
             )
         )
     return ScenarioSpec(
@@ -501,6 +582,14 @@ def compile_scenario(
     burst = np.zeros((n_ph + 1, n_slots), dtype=bool)
     blackout = np.zeros((n_ph + 1, n_slots), dtype=bool)
     group_b = np.zeros((n_ph + 1, n_slots), dtype=bool)
+    has_acc = any(p.accusers is not None for p in spec.phases)
+    has_forge = any(p.forgers is not None for p in spec.phases)
+    has_flood = any(p.floods is not None for p in spec.phases)
+    accuser = np.zeros((n_ph + 1, n_slots), dtype=bool)
+    forger = np.zeros((n_ph + 1, n_slots), dtype=bool)
+    flooder = np.zeros((n_ph + 1, n_slots), dtype=bool)
+    forge_fo = np.zeros(n_ph + 1, dtype=np.int32)
+    flood_fo = np.zeros(n_ph + 1, dtype=np.int32)
 
     for i, p in enumerate(spec.phases):
         phase_of_round[p.start : p.end] = i
@@ -521,6 +610,20 @@ def compile_scenario(
             blackout[i] = p.blackout.resolve(
                 n_peers, n_slots, node_map, shard_ranges
             )
+        if p.accusers is not None:
+            accuser[i] = p.accusers.resolve(
+                n_peers, n_slots, node_map, shard_ranges
+            )
+        if p.forgers is not None:
+            forger[i] = p.forgers.resolve(
+                n_peers, n_slots, node_map, shard_ranges
+            )
+            forge_fo[i] = p.forge_fanout
+        if p.floods is not None:
+            flooder[i] = p.floods.resolve(
+                n_peers, n_slots, node_map, shard_ranges
+            )
+            flood_fo[i] = p.flood_fanout
 
     return CompiledScenario(
         phase_of_round=jnp.asarray(phase_of_round),
@@ -532,11 +635,21 @@ def compile_scenario(
         blackout=jnp.asarray(blackout),
         group_b=jnp.asarray(group_b),
         join_burst=jnp.asarray(jburst) if spec.uses_join_burst else None,
+        accuser=jnp.asarray(accuser) if has_acc else None,
+        forger=jnp.asarray(forger) if has_forge else None,
+        flooder=jnp.asarray(flooder) if has_flood else None,
+        forge_fanout=jnp.asarray(forge_fo) if has_forge else None,
+        flood_fanout=jnp.asarray(flood_fo) if has_flood else None,
         name=spec.name,
         has_partition=any(p.partition is not None for p in spec.phases),
         has_blackout=any(p.blackout is not None for p in spec.phases),
         has_churn=any(p.churn_leave or p.churn_join for p in spec.phases),
         has_loss_delay=any(p.loss or p.delay for p in spec.phases),
         has_join_burst=spec.uses_join_burst,
+        has_accusers=has_acc,
+        has_forgers=has_forge,
+        has_floods=has_flood,
+        max_forge_fanout=spec.max_forge_fanout,
+        max_flood_fanout=spec.max_flood_fanout,
         n_rounds=total_rounds,
     )

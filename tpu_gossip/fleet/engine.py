@@ -46,12 +46,12 @@ __all__ = [
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "num_rounds"),
+    static_argnames=("cfg", "num_rounds", "liveness"),
     donate_argnames=("state",),
 )
 def simulate_fleet(
     state, cfg, num_rounds: int, scenario=None, growth=None, stream=None,
-    control=None,
+    control=None, liveness=None,
 ):
     """Run K stacked swarms ``num_rounds`` rounds in one batched program.
 
@@ -70,7 +70,7 @@ def simulate_fleet(
     def lane(st, sc, gr, sp, cp):
         def body(carry, _):
             return gossip_round(carry, cfg, scenario=sc, growth=gr,
-                                stream=sp, control=cp)
+                                stream=sp, control=cp, liveness=liveness)
 
         return jax.lax.scan(body, st, None, length=num_rounds)
 
@@ -105,6 +105,7 @@ def run_campaign(campaign, *, keep_states: bool = True):
     fin, stats = simulate_fleet(
         st, campaign.cfg, campaign.rounds, campaign.scenario,
         campaign.growth, campaign.stream, campaign.control,
+        campaign.liveness,
     )
     if not keep_states:
         campaign.states = fin  # the donated input is gone; keep the result
@@ -123,7 +124,7 @@ def run_lane_solo(campaign, k: int):
 
     st, sc, gr, sp, cp = campaign.lane(k)
     return simulate(st, campaign.cfg, campaign.rounds, None, "fused",
-                    sc, gr, sp, cp)
+                    sc, gr, sp, cp, None, campaign.liveness)
 
 
 def state_digest(state) -> str:

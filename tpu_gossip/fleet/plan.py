@@ -125,6 +125,7 @@ _BASE_KEYS = {
     "stream_rate", "slot_ttl", "stream_origins", "stream_hashes",
     "control", "control_lo", "control_hi", "refresh_every",
     "grow", "grow_rate", "grow_capacity",
+    "quorum_k", "suspicion_window", "accusation_budget",
 }
 
 
@@ -236,6 +237,10 @@ class CompiledCampaign:
     lanes: tuple[LaneInfo, ...]
     families: tuple[FamilySpec, ...]
     base: dict
+    # the quorum-detector spec (kernels/liveness.py) is jit-STATIC and
+    # hashable, so it is shared by every lane rather than stacked — the
+    # shared-static-shape rule's degenerate case
+    liveness: object | None = None  # QuorumSpec (static, lane-shared)
     # set by run_campaign(keep_states=False): the initial states were
     # DONATED and self.states now holds the FINAL states — lane
     # extraction would silently hand out post-run state, so it refuses
@@ -533,7 +538,14 @@ def _unify_scenarios(compiled: list, name: str):
     flags = {
         f: any(getattr(c, f) for c in compiled)
         for f in ("has_partition", "has_blackout", "has_churn",
-                  "has_loss_delay", "has_join_burst")
+                  "has_loss_delay", "has_join_burst", "has_accusers",
+                  "has_forgers", "has_floods")
+    }
+    # the static draw widths unify to the batch maximum (per-phase traced
+    # fanouts stay the lane's own — columns past them are masked)
+    statics = {
+        f: max(getattr(c, f) for c in compiled)
+        for f in ("max_forge_fanout", "max_flood_fanout")
     }
 
     def pad1(a, rows):
@@ -541,20 +553,34 @@ def _unify_scenarios(compiled: list, name: str):
             a, jnp.zeros((rows - a.shape[0],) + a.shape[1:], dtype=a.dtype)
         ]) if a.shape[0] < rows else a
 
+    def unify_opt(c, field, flag, n_cols=None, dtype=jnp.int32):
+        if not flags[flag]:
+            return None
+        a = getattr(c, field)
+        if a is None:
+            shape = (c.loss.shape[0],) if n_cols is None else (
+                c.loss.shape[0], n_cols)
+            a = jnp.zeros(shape, dtype=dtype)
+        return pad1(a, p_max)
+
     out = []
     for c in compiled:
-        jb = c.join_burst
-        if flags["has_join_burst"] and jb is None:
-            jb = jnp.zeros((c.loss.shape[0],), dtype=jnp.int32)
+        n_cols = c.burst.shape[1]
         out.append(_dc.replace(
             c,
             loss=pad1(c.loss, p_max), delay=pad1(c.delay, p_max),
             leave=pad1(c.leave, p_max), join=pad1(c.join, p_max),
             burst=pad1(c.burst, p_max), blackout=pad1(c.blackout, p_max),
             group_b=pad1(c.group_b, p_max),
-            join_burst=None if not flags["has_join_burst"] else pad1(jb, p_max),
+            join_burst=unify_opt(c, "join_burst", "has_join_burst"),
+            accuser=unify_opt(c, "accuser", "has_accusers", n_cols, bool),
+            forger=unify_opt(c, "forger", "has_forgers", n_cols, bool),
+            flooder=unify_opt(c, "flooder", "has_floods", n_cols, bool),
+            forge_fanout=unify_opt(c, "forge_fanout", "has_forgers"),
+            flood_fanout=unify_opt(c, "flood_fanout", "has_floods"),
             name=name,
             **flags,
+            **statics,
         ))
     return out
 
@@ -734,6 +760,14 @@ def compile_campaign(spec: CampaignSpec):
                         "grow (a lane cannot grow alone — capacity is a "
                         "static shape shared by the batch)"
                     )
+                if sspec.uses_adversaries and not int(b.get("quorum_k", 0)):
+                    raise CampaignError(
+                        f"family {lane.family!r}: Byzantine adversary "
+                        "phases (accusers/forgers/floods) need the "
+                        "quorum-defense planes; set [base] quorum_k "
+                        "(quorum_k = 1 reproduces the reference's "
+                        "single-report purge)"
+                    )
                 max_jb = max(max_jb, sspec.max_join_burst)
                 scen_lanes.append(compile_scenario(
                     sspec, n_peers=n_peers, n_slots=n_slots,
@@ -882,6 +916,33 @@ def compile_campaign(spec: CampaignSpec):
             ))
         _check_lane_structures(control_lanes, "control")
 
+    # ------------------------------------------------ quorum detector
+    liveness = None
+    if int(b.get("quorum_k", 0)):
+        from tpu_gossip.kernels.liveness import compile_quorum
+
+        try:
+            liveness = compile_quorum(
+                quorum_k=int(b["quorum_k"]),
+                window=int(b.get("suspicion_window",
+                                 2 * cfg.detect_period_rounds)),
+                budget=int(b.get("accusation_budget", 3)),
+            )
+        except ValueError as e:
+            raise CampaignError(f"[base] quorum: {e}") from None
+        if liveness.window < cfg.detect_period_rounds:
+            raise CampaignError(
+                f"[base] suspicion_window {liveness.window} is shorter "
+                f"than the detector sweep period "
+                f"({cfg.detect_period_rounds} rounds — the PING grace): "
+                "a suspicion would expire before its probe could refute"
+            )
+    elif any(b.get(k) for k in ("suspicion_window", "accusation_budget")):
+        raise CampaignError(
+            "[base] suspicion_window/accusation_budget shape the quorum "
+            "detector; set quorum_k"
+        )
+
     # ------------------------------------------------ per-lane states
     parent = jax.random.fold_in(
         jax.random.key(spec.seed), FLEET_STREAM_SALT
@@ -914,4 +975,5 @@ def compile_campaign(spec: CampaignSpec):
         lanes=tuple(lanes),
         families=spec.families,
         base=dict(b),
+        liveness=liveness,
     )

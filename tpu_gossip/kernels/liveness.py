@@ -15,14 +15,124 @@ PING + grace-wait is collapsed into the sweep: a responsive stale peer
 refreshes its heartbeat (exactly the reference's "heartbeat during the
 grace wait revives the node", Peer.py:309,339); an unresponsive one is
 declared dead, the vectorized form of the registry purge (Seed.py:358-406).
+
+QUORUM HARDENING (docs/adversarial_model.md): the reference's seeds purge
+a peer on a SINGLE "Dead Node" report (Seed.py:358-406 trusts the first
+reporter), so one lying peer can evict any healthy node, and an
+unauthenticated heartbeat relay keeps a dead one alive. The hardened
+detector (:class:`QuorumSpec`, :func:`quorum_liveness`) replaces the
+direct stale→PING→dead latch with a witness-quorum suspicion machine:
+
+    alive --stale on a sweep--> suspected --quorum_k distinct witness
+    confirmations inside a ``window``-round refutation window--> dead
+
+A suspected peer that answers its probe (the probe carries a nonce, so a
+third-party forgery cannot answer it) refutes: suspicion clears, votes
+reset, and every accusation the refutation exposes as false charges a
+STRIKE against its accuser — ``budget`` strikes latch the accuser into
+``quarantine`` (sends masked, accusations ignored, rewire slots released
+through the degree-credit book balance). On a healthy sweep the whole
+live witness cohort confirms a genuinely-stale suspect at once, so for
+any ``quorum_k`` up to the live witness count the hardened detector
+declares on the SAME sweep the direct detector would — quorum costs no
+detection latency (tests/conformance/test_liveness_band.py pins it), and
+``quorum_k=1`` with no adversaries reproduces the direct detector's
+trajectory bit for bit.
 """
 
 from __future__ import annotations
 
+import dataclasses
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["emit_heartbeats", "detect_failures"]
+__all__ = [
+    "SUSPECT_VOTE_CAP",
+    "SUSPECT_STRIKE_CAP",
+    "QuorumSpec",
+    "LivenessTelemetry",
+    "compile_quorum",
+    "pack_suspicion",
+    "unpack_suspicion",
+    "emit_heartbeats",
+    "detect_failures",
+    "forge_heartbeats",
+    "quorum_liveness",
+]
+
+
+class LivenessTelemetry(NamedTuple):
+    """Per-round hardened-detector counters for RoundStats (scalar i32)."""
+
+    evictions_new: jax.Array  # dead declarations this round
+    false_evictions: jax.Array  # of those, victims that were responsive
+    adv_accusations: jax.Array  # false dead-verdicts emitted this round
+    adv_forged: jax.Array  # forged heartbeats emitted this round
+
+# suspect_mark packing (core/state.py PLANES): votes in the low 8 bits
+# (saturating), strikes in the high 7 — max packed value 255 + 256*127 =
+# 32767, exactly int16's ceiling, so the packed plane can never overflow
+SUSPECT_VOTE_CAP = 255
+SUSPECT_STRIKE_CAP = 127
+
+
+@dataclasses.dataclass(frozen=True)
+class QuorumSpec:
+    """Compiled quorum-detector contract (jit-static, hashable).
+
+    ``quorum_k`` distinct witness confirmations — counted within ONE
+    round, where every voter emits at most once, and stored as the
+    suspicion's high-water cohort (max, never sum: a lone repeat
+    accuser cannot add itself up past the quorum) — declare a suspect
+    dead; ``window`` bounds how long a suspicion may wait for a
+    quorum-sized cohort before it expires (stale accusations cannot
+    slow-roll an eviction across the whole run); ``budget`` is the
+    false-accusation count that latches an
+    accuser into quarantine (0 disables quarantine). ``quorum_k=1``
+    degrades to the reference's single-report purge — with no adversaries
+    it reproduces the direct detector bit for bit (test-pinned), which is
+    the determinism anchor every stronger setting is measured against.
+    """
+
+    quorum_k: int = 1
+    window: int = 4
+    budget: int = 3
+
+    def __post_init__(self):
+        if not 1 <= self.quorum_k <= SUSPECT_VOTE_CAP:
+            raise ValueError(
+                f"quorum_k must lie in [1, {SUSPECT_VOTE_CAP}] (the packed "
+                f"vote counter saturates there); got {self.quorum_k}"
+            )
+        if self.window < 1:
+            raise ValueError(f"suspicion window must be >= 1 round; got "
+                             f"{self.window}")
+        if not 0 <= self.budget <= SUSPECT_STRIKE_CAP:
+            raise ValueError(
+                f"accusation budget must lie in [0, {SUSPECT_STRIKE_CAP}] "
+                f"(the packed strike counter saturates there); got "
+                f"{self.budget}"
+            )
+
+
+def compile_quorum(
+    quorum_k: int = 1, window: int = 4, budget: int = 3
+) -> QuorumSpec:
+    """Validate + freeze a quorum-detector spec (see QuorumSpec)."""
+    return QuorumSpec(quorum_k=quorum_k, window=window, budget=budget)
+
+
+def pack_suspicion(votes: jax.Array, strikes: jax.Array) -> jax.Array:
+    """votes (<= 255) + strikes (<= 127) -> the packed int16 plane."""
+    return (votes + 256 * strikes).astype(jnp.int16)
+
+
+def unpack_suspicion(mark: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """The packed plane -> (votes, strikes), both int32 for arithmetic."""
+    m = mark.astype(jnp.int32)  # graftlint: disable=mem-widening-cast -- transient unpack staging: the STORED plane stays the packed int16; vote/strike arithmetic (adding the witness-cohort count, an int32 scalar) must run wide before re-packing saturates it back down
+    return m % 256, m // 256
 
 
 def emit_heartbeats(
@@ -78,3 +188,204 @@ def detect_failures(
     )
     newly_dead = sweep & stale & ~responsive & ~declared_dead
     return new_last, declared_dead | newly_dead
+
+
+def forge_heartbeats(
+    last_hb: jax.Array,
+    suspect_round: jax.Array,
+    forger_ok: jax.Array,
+    rnd: jax.Array,
+    k_forge: jax.Array,
+    fanout_now: jax.Array,
+    max_fanout: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Forgery attack: adversary rows emit heartbeats ON BEHALF of other
+    peers, stalling the detector (the reference's heartbeat plane carries
+    no sender authentication — Peer.py:201-205 trusts the socket line).
+
+    Each row in ``forger_ok`` (phase forger mask ∧ alive ∧ not declared ∧
+    not quarantined — a quarantined forger's sends are masked) forges
+    ``fanout_now`` (traced, ≤ static ``max_fanout``) heartbeats at
+    uniformly sampled targets from the adversary stream. A forged
+    heartbeat refreshes the target's ``last_hb`` — delaying suspicion
+    ENTRY of a genuinely dead peer — but cannot answer an ACTIVE
+    suspicion's probe (the probe carries a nonce only the real peer can
+    echo, the standard anti-spoofing assumption the quorum machine is
+    built on), so suspected targets are never refreshed: once a dead
+    peer's staleness slips through the forgers' sampling, detection
+    proceeds. Returns ``(last_hb, n_forged)`` — the sends are billed by
+    the caller's telemetry.
+    """
+    from tpu_gossip.core.state import saturate_round
+
+    n = last_hb.shape[0]
+    tgt = jax.random.randint(k_forge, (n, max_fanout), 0, n)
+    act = (
+        forger_ok[:, None]
+        & (jnp.arange(max_fanout)[None, :] < fanout_now)
+    )
+    # a suspected target's probe cannot be answered by a third party —
+    # forged refreshes land only pre-suspicion
+    landed = act & (suspect_round[tgt] < 0)
+    new_last = last_hb.at[jnp.where(landed, tgt, n).reshape(-1)].max(
+        saturate_round(rnd, last_hb.dtype), mode="drop"
+    )
+    return new_last, jnp.sum(act, dtype=jnp.int32)
+
+
+def quorum_liveness(
+    spec: QuorumSpec,
+    last_hb: jax.Array,
+    alive: jax.Array,
+    silent: jax.Array,
+    declared_dead: jax.Array,
+    suspect_round: jax.Array,
+    suspect_mark: jax.Array,
+    quarantine: jax.Array,
+    exists: jax.Array,
+    rnd: jax.Array,
+    timeout_rounds: int,
+    detect_period_rounds: int,
+    k_accuse: jax.Array | None = None,
+    accuser_ok: jax.Array | None = None,
+) -> dict:
+    """One round of the hardened detector (module docstring has the state
+    machine). Replaces :func:`detect_failures` when a :class:`QuorumSpec`
+    is active; at ``quorum_k=1`` with no adversaries the declared-dead
+    trajectory — and the whole state, the suspicion planes included — is
+    bit-identical to the direct detector's whenever at least one live
+    witness exists (entry, cohort confirmation, and declaration land on
+    the same sweep, so suspicion never persists across rounds).
+
+    ``accuser_ok`` (None = no accusation attack this round) marks rows
+    emitting one false dead-verdict each against a victim sampled
+    uniformly from the adversary stream (``k_accuse``). An accusation IS
+    a witness vote: it latches suspicion on its victim and counts toward
+    the quorum — ``quorum_k=1`` evicts on a single report, exactly the
+    reference's vulnerability. An accusation whose victim refutes (the
+    victim answers its probe inside the window — charged at accusation
+    time against a responsive, not-declared victim, the attribution the
+    guaranteed-within-window refutation broadcast carries) is a STRIKE
+    against the accuser; ``spec.budget`` strikes latch ``quarantine``.
+
+    Returns a dict: the five updated planes plus ``newly_quarantined``
+    (the caller releases those rows' rewire slots through the
+    degree-credit book) and the round's telemetry counters
+    (``evictions_new``, ``false_evictions``, ``adv_accusations``).
+    """
+    from tpu_gossip.core.state import saturate_round
+
+    n = last_hb.shape[0]
+    votes, strikes = unpack_suspicion(suspect_mark)
+    responsive = alive & ~silent
+    sweep = (rnd % detect_period_rounds) == 0
+    stale = (rnd - last_hb) > timeout_rounds  # graftlint: disable=mem-widening-cast -- transient staleness staging, same license as detect_failures above
+    suspected = suspect_round >= 0
+
+    # refutation + revival: the sweep probes every suspect and every
+    # stale peer; a responsive one answers (nonce-carrying — forgery
+    # cannot), refreshing its heartbeat and clearing any suspicion
+    revive = sweep & stale & responsive
+    last_hb = jnp.where(revive, saturate_round(rnd, last_hb.dtype), last_hb)
+    refuted = sweep & suspected & responsive
+    # window expiry: a suspicion that outlived the refutation window
+    # without reaching quorum resets — stale accusations cannot pool
+    # votes across the whole run
+    expired = suspected & ((rnd - suspect_round) > spec.window)  # graftlint: disable=mem-widening-cast -- same transient staging license
+    cleared = refuted | expired
+    suspect_round = jnp.where(cleared, -1, suspect_round).astype(
+        suspect_round.dtype
+    )
+    votes = jnp.where(cleared, 0, votes)
+    suspected = suspect_round >= 0
+
+    # entry + cohort confirmation (sweep rounds): a stale unresponsive
+    # peer enters suspicion, and every CURRENT suspect that stays stale
+    # and unanswering is confirmed by the whole live witness cohort at
+    # once — the sweep is the vectorized form of each witness's
+    # independent probe, so quorum_k <= n_wit declares on the same sweep
+    # the direct detector would (no added latency, band test-pinned)
+    enter = sweep & stale & ~responsive & ~declared_dead & ~suspected
+    suspect_round = jnp.where(
+        enter, saturate_round(rnd, suspect_round.dtype), suspect_round
+    )
+    suspected = suspected | enter
+    n_wit = jnp.sum(
+        responsive & ~declared_dead & ~quarantine, dtype=jnp.int32
+    )
+    confirm = sweep & suspected & stale & ~responsive & ~declared_dead
+    # THIS round's distinct-witness cohort: the sweep's confirming
+    # witnesses plus (below) the round's accusers — every voter emits at
+    # most once per round, so within one round the count IS a distinct
+    # count. The stored vote plane keeps the suspicion's largest
+    # single-round cohort (max, never sum): a lone Byzantine reporter
+    # re-accusing the same victim on later rounds of the window can
+    # never add itself up past the quorum — "quorum_k DISTINCT
+    # witnesses" holds by construction.
+    round_votes = jnp.where(confirm, jnp.minimum(n_wit, SUSPECT_VOTE_CAP), 0)
+
+    # accusation attack: one false dead-verdict per active accuser, each
+    # a vote against a uniformly sampled victim
+    vic_valid = None
+    vic = None
+    n_accusations = jnp.zeros((), dtype=jnp.int32)
+    if accuser_ok is not None:
+        vic = jax.random.randint(k_accuse, (n,), 0, n)
+        rows = jnp.arange(n, dtype=vic.dtype)
+        vic_valid = (
+            accuser_ok
+            & exists[vic]
+            & alive[vic]
+            & ~declared_dead[vic]
+            & (vic != rows)
+        )
+        accused = jnp.zeros((n,), dtype=bool).at[
+            jnp.where(vic_valid, vic, n)
+        ].set(True, mode="drop")
+        counts = jnp.zeros((n,), dtype=jnp.int32).at[
+            jnp.where(vic_valid, vic, n)
+        ].add(1, mode="drop")
+        suspect_round = jnp.where(
+            accused & ~suspected, saturate_round(rnd, suspect_round.dtype),
+            suspect_round,
+        )
+        suspected = suspected | accused
+        round_votes = round_votes + counts
+        n_accusations = jnp.sum(vic_valid, dtype=jnp.int32)
+    votes = jnp.minimum(
+        jnp.maximum(votes, round_votes), SUSPECT_VOTE_CAP
+    )
+
+    # declaration: quorum reached inside the window (checked every round —
+    # accusation votes land off-sweep too)
+    newly_dead = suspected & (votes >= spec.quorum_k) & ~declared_dead
+    declared_dead = declared_dead | newly_dead
+    suspect_round = jnp.where(newly_dead, -1, suspect_round).astype(
+        suspect_round.dtype
+    )
+    votes = jnp.where(newly_dead, 0, votes)
+
+    # strikes + quarantine: an accusation the victim survives to refute
+    # charges its accuser; budget crossings latch the quarantine verdict
+    newly_q = jnp.zeros((n,), dtype=bool)
+    if accuser_ok is not None and spec.budget > 0:
+        failed = vic_valid & responsive[vic] & ~newly_dead[vic]
+        strikes = jnp.minimum(
+            strikes + failed.astype(jnp.int32), SUSPECT_STRIKE_CAP
+        )
+        newly_q = (strikes >= spec.budget) & ~quarantine
+        quarantine = quarantine | newly_q
+
+    return {
+        "last_hb": last_hb,
+        "declared_dead": declared_dead,
+        "suspect_round": suspect_round,
+        "suspect_mark": pack_suspicion(votes, strikes),
+        "quarantine": quarantine,
+        "newly_quarantined": newly_q,
+        "evictions_new": jnp.sum(newly_dead, dtype=jnp.int32),
+        "false_evictions": jnp.sum(
+            newly_dead & responsive, dtype=jnp.int32
+        ),
+        "adv_accusations": n_accusations,
+    }

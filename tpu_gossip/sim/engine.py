@@ -100,11 +100,25 @@ class RoundStats(NamedTuple):
     control_fanout: jax.Array  # i32 — effective fanout this round (0 off)
     msgs_duplicate: jax.Array  # i32 — deliveries landing on already-seen slots
     control_refreshed: jax.Array  # i32 — PeerSwap swaps applied this round
+    # hardened-liveness / adversarial track (kernels/liveness.py
+    # QuorumSpec, docs/adversarial_model.md) — all 0 unless a quorum
+    # detector is active (absent subsystems cost nothing, counters
+    # included). evictions_new/false_evictions count THIS round's dead
+    # declarations and how many hit responsive victims (the eviction
+    # precision metric's numerators); dead_undeclared is the genuinely
+    # dead-but-undetected count (the forgery detection-latency metric);
+    # the adv_* counters bill the attack plane's emissions.
+    evictions_new: jax.Array  # i32 — dead declarations this round
+    false_evictions: jax.Array  # i32 — of those, responsive victims
+    n_quarantined: jax.Array  # i32 — rows under the quarantine verdict
+    dead_undeclared: jax.Array  # i32 — members dead but not yet declared
+    adv_accusations: jax.Array  # i32 — false dead-verdicts this round
+    adv_forged: jax.Array  # i32 — forged heartbeats this round
 
 
 def _stats(
     state: SwarmState, msgs_sent: jax.Array, fstats=None, growth=None,
-    stream=None, stel=None, ctel=None,
+    stream=None, stel=None, ctel=None, ltel=None, liveness=None,
 ) -> RoundStats:
     live = state.alive & ~state.declared_dead
     z = jnp.zeros((), dtype=jnp.int32)
@@ -157,6 +171,22 @@ def _stats(
         control_fanout=z if ctel is None else ctel.fanout,
         msgs_duplicate=z if ctel is None else ctel.duplicate,
         control_refreshed=z if ctel is None else ctel.refreshed,
+        evictions_new=z if ltel is None else ltel.evictions_new,
+        false_evictions=z if ltel is None else ltel.false_evictions,
+        # state-derived defense counters: priced only on hardened runs
+        n_quarantined=(
+            z if liveness is None
+            else jnp.sum(state.quarantine, dtype=jnp.int32)
+        ),
+        dead_undeclared=(
+            z if liveness is None
+            else jnp.sum(
+                state.exists & ~state.alive & ~state.declared_dead,
+                dtype=jnp.int32,
+            )
+        ),
+        adv_accusations=z if ltel is None else ltel.adv_accusations,
+        adv_forged=z if ltel is None else ltel.adv_forged,
     )
 
 
@@ -796,6 +826,12 @@ def advance_round(
     control=None,
     rctl=None,
     pipe_buf: jax.Array | None = None,
+    liveness=None,
+    has_accusers: bool = False,
+    has_forgers: bool = False,
+    forge_width: int = 0,
+    k_accuse: jax.Array | None = None,
+    k_forge: jax.Array | None = None,
 ) -> tuple[SwarmState, RoundStats]:
     """Everything after dissemination: dedup-merge, SIR, liveness, churn,
     growth admission, streaming age-out + injection, adaptive control.
@@ -867,6 +903,15 @@ def advance_round(
     caller just issued for the next round's delivery. ``None`` (every
     serial caller) carries ``state.pipe_buf`` untouched, the no-pipeline
     hot path.
+
+    ``liveness`` (a :class:`~tpu_gossip.kernels.liveness.QuorumSpec`)
+    hardens the liveness stage into the witness-quorum suspicion
+    machine (docs/adversarial_model.md); ``k_accuse``/``k_forge`` are
+    the adversary stream's per-round children (derived once by the
+    round driver) consumed when the scenario's static ``has_accusers``/
+    ``has_forgers`` flags are set. ``liveness=None`` runs the historical
+    direct detector and carries the suspicion planes untouched —
+    unhardened rounds reproduce the pre-defense trajectory bit for bit.
     """
     from tpu_gossip.sim.stages import build_round_stages, run_stages
 
@@ -882,21 +927,28 @@ def advance_round(
         "join_round": state.join_round, "admitted_by": state.admitted_by,
         "degree_credit": state.degree_credit,
         "slot_lease": state.slot_lease, "control_lvl": state.control_lvl,
+        "suspect_round": state.suspect_round,
+        "suspect_mark": state.suspect_mark,
+        "quarantine": state.quarantine,
         "rng": state.rng,
         # dissemination products + round inputs
         "incoming": incoming, "transmit": transmit, "receptive": receptive,
         "rnd": rnd, "k_leave": k_leave, "k_join": k_join,
+        "k_accuse": k_accuse, "k_forge": k_forge,
         "faults": faults, "fstats": fstats, "rctl": rctl,
         "seen_prev": state.seen,
         "held": state.fault_held if fault_held is None else fault_held,
         # defaults the optional stages overwrite
         "fresh": None, "expired": None, "stel": None, "ctel": None,
+        "ltel": None,
     }
     values = run_stages(
         build_round_stages(
             cfg, tail=tail, has_faults=faults is not None,
             churn_faults=churn_faults, growth=growth, stream=stream,
-            control=control,
+            control=control, liveness=liveness,
+            has_accusers=has_accusers, has_forgers=has_forgers,
+            forge_width=forge_width,
         ),
         values,
     )
@@ -931,16 +983,21 @@ def advance_round(
         slot_lease=values["slot_lease"],
         control_lvl=values["control_lvl"],
         pipe_buf=state.pipe_buf if pipe_buf is None else pipe_buf,
+        suspect_round=values["suspect_round"],
+        suspect_mark=values["suspect_mark"],
+        quarantine=values["quarantine"],
         rng=key,
         round=rnd,
     )
     return new_state, _stats(new_state, msgs_sent, fstats, growth, stream,
-                             values["stel"], values["ctel"])
+                             values["stel"], values["ctel"],
+                             values["ltel"], liveness)
 
 
 def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
     scenario=None, growth=None, stream=None, control=None, pipeline=None,
+    liveness=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -987,6 +1044,16 @@ def gossip_round(
     local engine the buffered "exchange" is the dissemination product
     itself (there is no collective to overlap), which is exactly what
     makes PIPELINED local-vs-mesh bit-identity testable.
+
+    ``liveness`` (a :class:`~tpu_gossip.kernels.liveness.QuorumSpec`)
+    swaps the direct failure detector for the witness-quorum suspicion
+    machine + quarantine (docs/adversarial_model.md) and is REQUIRED
+    when ``scenario`` fields Byzantine adversaries (accusers/forgers/
+    floods). Its attack draws derive from the registered
+    ``ADVERSARY_STREAM_SALT`` stream at global shape, so
+    ``liveness=None`` — and, with at least one live witness,
+    ``quorum_k=1`` under no adversaries — reproduce the historical
+    detector's trajectory bit for bit.
     """
     from tpu_gossip.sim.stages import run_protocol_round
 
@@ -996,18 +1063,19 @@ def gossip_round(
     return run_protocol_round(
         state, cfg, disseminate, tail=tail, scenario=scenario,
         growth=growth, stream=stream, control=control, pipeline=pipeline,
+        liveness=liveness,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "num_rounds", "tail", "pipeline"),
+    static_argnames=("cfg", "num_rounds", "tail", "pipeline", "liveness"),
     donate_argnames=("state",),
 )
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
     tail: str = "fused", scenario=None, growth=None, stream=None,
-    control=None, pipeline=None,
+    control=None, pipeline=None, liveness=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -1034,7 +1102,7 @@ def simulate(
         nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
                                   scenario=scenario, growth=growth,
                                   stream=stream, control=control,
-                                  pipeline=pipeline)
+                                  pipeline=pipeline, liveness=liveness)
         return nxt, stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
@@ -1042,7 +1110,8 @@ def simulate(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_rounds", "slot", "tail", "pipeline"),
+    static_argnames=("cfg", "max_rounds", "slot", "tail", "pipeline",
+                     "liveness"),
     donate_argnames=("state",),
 )
 def run_until_coverage(
@@ -1058,6 +1127,7 @@ def run_until_coverage(
     stream=None,
     control=None,
     pipeline=None,
+    liveness=None,
 ) -> SwarmState:
     """Round loop until ``coverage(slot) >= target`` (or ``max_rounds``).
 
@@ -1083,7 +1153,7 @@ def run_until_coverage(
     def body(s: SwarmState) -> SwarmState:
         nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
                               growth=growth, stream=stream, control=control,
-                              pipeline=pipeline)
+                              pipeline=pipeline, liveness=liveness)
         return nxt
 
     return jax.lax.while_loop(cond, body, state)

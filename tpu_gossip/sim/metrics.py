@@ -34,6 +34,7 @@ __all__ = [
     "stream_episodes",
     "steady_state_report",
     "reliability_report",
+    "liveness_report",
 ]
 
 
@@ -460,6 +461,54 @@ def reliability_report(
             None if p99 is None else round(p99 * round_seconds, 1)
         ),
         "peak_coverage": float(cov.max()) if cov.size else 0.0,
+    }
+
+
+def liveness_report(stats: RoundStats) -> dict:
+    """The hardened detector's eviction/quarantine summary
+    (docs/adversarial_model.md) — the CLI's ``liveness`` summary block
+    and the byzantine_siege demonstration's judged metrics.
+
+    ``eviction_precision`` is the fraction of dead declarations that hit
+    genuinely unreachable peers (1 − false/total; a false eviction is a
+    declaration against a victim that was responsive at declaration
+    time — the accusation attack's success metric). ``eviction_recall``
+    is the fraction of the horizon's discovered genuinely-dead
+    population that got declared: true declarations over (true
+    declarations + still-undeclared dead at the horizon) — under a
+    forgery attack the undeclared term is exactly the detection the
+    forgers stalled. ``forgery_stall_rounds`` counts rounds with at
+    least one genuinely dead, undeclared member — the detection-latency-
+    under-forgery figure (for a single blackout it is the latency
+    itself; under sustained churn it upper-bounds the per-death
+    latencies). All counters are 0 on unhardened runs (the quorum track
+    is priced only when a QuorumSpec is active). Host-side, like every
+    reporting helper here.
+    """
+    evictions = int(np.asarray(stats.evictions_new).astype(np.int64).sum())
+    false_ev = int(np.asarray(stats.false_evictions).astype(np.int64).sum())
+    true_ev = evictions - false_ev
+    undeclared = np.asarray(stats.dead_undeclared)
+    undeclared_final = int(undeclared[-1]) if undeclared.size else 0
+    return {
+        "evictions": evictions,
+        "false_evictions": false_ev,
+        "eviction_precision": round(
+            true_ev / evictions, 4
+        ) if evictions else None,
+        "eviction_recall": round(
+            true_ev / (true_ev + undeclared_final), 4
+        ) if true_ev + undeclared_final else None,
+        "quarantined": int(np.asarray(stats.n_quarantined)[-1])
+        if np.asarray(stats.n_quarantined).size else 0,
+        "dead_undeclared_final": undeclared_final,
+        "forgery_stall_rounds": int((undeclared > 0).sum()),
+        "accusations": int(
+            np.asarray(stats.adv_accusations).astype(np.int64).sum()
+        ),
+        "forged_heartbeats": int(
+            np.asarray(stats.adv_forged).astype(np.int64).sum()
+        ),
     }
 
 

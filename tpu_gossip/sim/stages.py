@@ -172,19 +172,50 @@ def run_stages(stages: tuple[Stage, ...], values: dict) -> dict:
 # carry contract made explicit
 
 
-def _liveness_stage(cfg, has_faults: bool) -> Stage:
+def _liveness_stage(
+    cfg, has_faults: bool, liveness=None,
+    has_accusers: bool = False, has_forgers: bool = False,
+    forge_width: int = 0,
+) -> Stage:
     """Heartbeat emission + failure detection (row-level O(N)).
 
     A blacked-out node is cut off from the heartbeat plane too: it emits
     nothing anyone hears and answers no detector probe — exactly a
     silent peer for the phase's duration; dead declarations it earns
     persist (the reference's registry purge has no resurrection either).
+
+    With ``liveness`` (a :class:`~tpu_gossip.kernels.liveness.
+    QuorumSpec`) the direct stale→PING→dead latch is replaced by the
+    witness-quorum suspicion machine (``kernels.liveness.
+    quorum_liveness``; docs/adversarial_model.md), the adversary attack
+    half runs here too — forged heartbeats before the sweep, false
+    dead-verdict accusations as quorum votes — and newly quarantined
+    accusers have their rewire slots RELEASED through the degree-credit
+    book balance (the churn/growth/PeerSwap invariant: sum(credit)
+    tracks the stored fresh targets of re-wired rows exactly).
+    ``liveness=None`` runs the historical detector and carries the
+    suspicion planes untouched — an unhardened run never pays for them.
     """
-    from tpu_gossip.kernels.liveness import detect_failures, emit_heartbeats
+    from tpu_gossip.kernels.liveness import (
+        LivenessTelemetry, detect_failures, emit_heartbeats,
+        forge_heartbeats, quorum_liveness,
+    )
 
     reads = ("silent", "alive", "declared_dead", "last_hb", "rnd") + (
         ("faults",) if has_faults else ()
     )
+    writes = ("last_hb", "declared_dead")
+    if liveness is not None:
+        reads = reads + (
+            "exists", "suspect_round", "suspect_mark", "quarantine",
+            "rewired", "rewire_targets", "degree_credit",
+        ) + (("k_accuse",) if has_accusers else ()) + (
+            ("k_forge",) if has_forgers else ()
+        )
+        writes = writes + (
+            "suspect_round", "suspect_mark", "quarantine", "rewired",
+            "rewire_targets", "degree_credit", "ltel",
+        )
 
     def fn(ctx):
         silent_now = (
@@ -196,16 +227,75 @@ def _liveness_stage(cfg, has_faults: bool) -> Stage:
             ctx["last_hb"], ctx["alive"], silent_now, ctx["declared_dead"],
             ctx["rnd"], cfg.hb_period_rounds,
         )
-        last_hb, declared_dead = detect_failures(
-            last_hb, ctx["alive"], silent_now, ctx["declared_dead"],
-            ctx["rnd"], cfg.timeout_rounds, cfg.detect_period_rounds,
+        if liveness is None:
+            last_hb, declared_dead = detect_failures(
+                last_hb, ctx["alive"], silent_now, ctx["declared_dead"],
+                ctx["rnd"], cfg.timeout_rounds, cfg.detect_period_rounds,
+            )
+            return {"last_hb": last_hb, "declared_dead": declared_dead}
+
+        z = jnp.zeros((), dtype=jnp.int32)
+        adv_forged = z
+        # an adversary must be able to SEND: dead, declared, quarantined,
+        # or blacked-out rows emit nothing (has_accusers/has_forgers imply
+        # a scenario, so ctx["faults"] is always present here — and the
+        # blackout table is materialized on every compiled scenario)
+        if has_forgers or has_accusers:
+            rf = ctx["faults"]
+            can_emit = (
+                ctx["alive"] & ~ctx["declared_dead"] & ~ctx["quarantine"]
+                & ~rf.blackout
+            )
+        if has_forgers:
+            last_hb, adv_forged = forge_heartbeats(
+                last_hb, ctx["suspect_round"], rf.forger & can_emit,
+                ctx["rnd"], ctx["k_forge"], rf.forge_fanout, forge_width,
+            )
+        out = quorum_liveness(
+            liveness, last_hb, ctx["alive"], silent_now,
+            ctx["declared_dead"], ctx["suspect_round"], ctx["suspect_mark"],
+            ctx["quarantine"], ctx["exists"], ctx["rnd"],
+            cfg.timeout_rounds, cfg.detect_period_rounds,
+            k_accuse=ctx["k_accuse"] if has_accusers else None,
+            accuser_ok=rf.accuser & can_emit if has_accusers else None,
         )
-        return {"last_hb": last_hb, "declared_dead": declared_dead}
+        # quarantine releases the row's fresh edges: the discarded
+        # targets' degree credit is returned (the book-balance invariant
+        # the fold/refresh paths lean on) and the row leaves the
+        # re-wired set — its delivery reverts to its CSR slot edges
+        rewired = ctx["rewired"]
+        rewire_targets = ctx["rewire_targets"]
+        degree_credit = ctx["degree_credit"]
+        newly_q = out["newly_quarantined"]
+        n = rewired.shape[0]
+        q_rw = newly_q & rewired
+        released = q_rw[:, None] & (rewire_targets >= 0)
+        degree_credit = degree_credit.at[
+            jnp.where(released, rewire_targets, n).reshape(-1)
+        ].add(-1, mode="drop")
+        rewire_targets = jnp.where(q_rw[:, None], -1, rewire_targets)
+        rewired = rewired & ~newly_q
+        return {
+            "last_hb": out["last_hb"],
+            "declared_dead": out["declared_dead"],
+            "suspect_round": out["suspect_round"],
+            "suspect_mark": out["suspect_mark"],
+            "quarantine": out["quarantine"],
+            "rewired": rewired,
+            "rewire_targets": rewire_targets,
+            "degree_credit": degree_credit,
+            "ltel": LivenessTelemetry(
+                evictions_new=out["evictions_new"],
+                false_evictions=out["false_evictions"],
+                adv_accusations=out["adv_accusations"],
+                adv_forged=adv_forged,
+            ),
+        }
 
-    return Stage("liveness", reads, ("last_hb", "declared_dead"), fn)
+    return Stage("liveness", reads, writes, fn)
 
 
-def _churn_stage(cfg, burst: bool) -> Stage:
+def _churn_stage(cfg, burst: bool, defended: bool = False) -> Stage:
     """Poisson churn, row-level half (BASELINE config 5) + re-wiring draws.
 
     The fresh-slot SLOT-ARRAY resets are deferred to the fused tail (they
@@ -215,12 +305,22 @@ def _churn_stage(cfg, burst: bool) -> Stage:
     scenario's leave/join probabilities fold into the SAME draws as
     per-node thresholds — keys and shapes untouched, so engines stay
     bit-identical and a quiescent phase changes nothing.
+
+    ``defended`` (a QuorumSpec is active): QUARANTINED rows rejoin on
+    their slot's existing CSR edges instead of drawing fresh
+    degree-preferential ones — the quarantine verdict is an identity
+    verdict, so a caught adversary cannot re-colonize neighborhoods
+    through the churn path (the PeerSwap-randomness argument,
+    docs/adversarial_model.md). Draw keys and shapes are untouched (only
+    masks move), so a run with nobody quarantined is value-identical.
     """
     reads = (
         "alive", "silent", "exists", "last_hb", "declared_dead", "rewired",
         "rewire_targets", "degree_credit", "row_ptr", "col_idx", "rnd",
         "k_leave", "k_join",
-    ) + (("faults",) if burst else ())
+    ) + (("faults",) if burst else ()) + (
+        ("quarantine",) if defended else ()
+    )
     writes = (
         "alive", "silent", "last_hb", "declared_dead", "rewired",
         "rewire_targets", "degree_credit", "fresh",
@@ -269,6 +369,10 @@ def _churn_stage(cfg, burst: bool) -> Stage:
             )
             alive = alive | join
             fresh = join
+            # quarantined identities rejoin on their slot's existing CSR
+            # edges — no fresh degree-preferential draws (defense only;
+            # all-False quarantine makes this the identity)
+            fresh_rw = fresh & ~ctx["quarantine"] if defended else fresh
             silent = silent & ~fresh
             from tpu_gossip.core.state import saturate_round
 
@@ -304,9 +408,11 @@ def _churn_stage(cfg, burst: bool) -> Stage:
                     # not O(N) (~38 ms of a 1M churn round,
                     # docs/kernel_profile_1m.md); joiners past cap rejoin
                     # on their slot's existing edges
-                    jrows = jnp.nonzero(fresh, size=cap, fill_value=0)[0]
+                    jrows = jnp.nonzero(fresh_rw, size=cap, fill_value=0)[0]
                     draw_shape = (cap, s)
-                    jlive = jnp.arange(cap) < jnp.sum(fresh, dtype=jnp.int32)
+                    jlive = jnp.arange(cap) < jnp.sum(
+                        fresh_rw, dtype=jnp.int32
+                    )
                 draws = ctx["col_idx"][
                     jax.random.randint(k_rw, draw_shape, 0, e_real)
                 ]
@@ -328,19 +434,21 @@ def _churn_stage(cfg, burst: bool) -> Stage:
                 # edges granted and GRANT credit to the new draws. One
                 # (N, S)-index scatter pair, churn-join rounds with
                 # re-wiring only.
-                released = (fresh & rewired)[:, None] & (rewire_targets >= 0)
+                released = (fresh_rw & rewired)[:, None] & (
+                    rewire_targets >= 0
+                )
                 degree_credit = degree_credit.at[
                     jnp.where(released, rewire_targets, n).reshape(-1)
                 ].add(-1, mode="drop")
                 if cap is None:
                     degree_credit = degree_credit.at[
-                        jnp.where(fresh[:, None] & (draws >= 0), draws, n)
+                        jnp.where(fresh_rw[:, None] & (draws >= 0), draws, n)
                         .reshape(-1)
                     ].add(1, mode="drop")
                     rewire_targets = jnp.where(
-                        fresh[:, None], draws, rewire_targets
+                        fresh_rw[:, None], draws, rewire_targets
                     )
-                    rewired = rewired | fresh
+                    rewired = rewired | fresh_rw
                 else:
                     sel_rows = jnp.where(jlive, jrows, n)  # n = dropped
                     degree_credit = degree_credit.at[
@@ -540,6 +648,10 @@ def build_round_stages(
     growth=None,
     stream=None,
     control=None,
+    liveness=None,
+    has_accusers: bool = False,
+    has_forgers: bool = False,
+    forge_width: int = 0,
 ) -> tuple[Stage, ...]:
     """The post-dissemination stage DAG for one config (trace-time).
 
@@ -550,11 +662,19 @@ def build_round_stages(
     initial values untouched) — the "absent planes cost nothing"
     contract, now enforced structurally instead of by hand-ordered
     ``if`` blocks in five engines.
+
+    ``liveness`` (a :class:`~tpu_gossip.kernels.liveness.QuorumSpec`)
+    hardens the liveness stage into the witness-quorum suspicion machine
+    (+ the accusation/forgery attack half when the scenario's static
+    ``has_accusers``/``has_forgers`` flags say so); ``None`` keeps the
+    historical direct detector and its exact carry contract.
     """
     burst = has_faults and churn_faults
-    stages: list[Stage] = [_liveness_stage(cfg, has_faults)]
+    stages: list[Stage] = [_liveness_stage(
+        cfg, has_faults, liveness, has_accusers, has_forgers, forge_width,
+    )]
     if cfg.churn_leave_prob > 0.0 or cfg.churn_join_prob > 0.0 or burst:
-        stages.append(_churn_stage(cfg, burst))
+        stages.append(_churn_stage(cfg, burst, defended=liveness is not None))
     if growth is not None:
         stages.append(_growth_stage(cfg, growth, has_faults))
     if stream is not None:
@@ -593,6 +713,7 @@ def run_protocol_round(
     stream=None,
     control=None,
     pipeline: PipelineSpec | None = None,
+    liveness=None,
 ):
     """One whole protocol round, engine-agnostic: the shared driver.
 
@@ -619,17 +740,44 @@ def run_protocol_round(
     """
     from tpu_gossip.sim import engine as _engine
 
+    if scenario is not None and scenario.has_adversary and liveness is None:
+        raise ValueError(
+            "the scenario fields Byzantine adversaries (accusers/forgers/"
+            "floods) but no QuorumSpec is active — adversary rounds need "
+            "the defense planes compiled in; pass liveness=compile_quorum"
+            "(...) (quorum_k=1 reproduces the reference's single-report "
+            "purge)"
+        )
     _engine.validate_rewire_width(state, cfg)
     rnd = state.round + 1
     key, k_push, k_pull, k_leave, k_join = jax.random.split(state.rng, 5)
     _, transmitter, receptive = _engine.compute_roles(state)
     transmit = _engine.transmit_bitmap(state, cfg, transmitter)
+    if liveness is not None:
+        # the quarantine verdict masks a peer's SENDS (its pushes offer
+        # nothing; it still receives and still counts as a live member —
+        # it is a suspected liar, not a purged one). The no-defense path
+        # never reads the plane, so unhardened rounds stay bit-identical
+        # to pre-defense ones.
+        transmit = transmit & ~state.quarantine[:, None]
     rctl = None
     if control is not None:
         from tpu_gossip.control.engine import control_round
 
         rctl = control_round(control, state,
                              want_needy=cfg.mode == "push_pull")
+    k_accuse = k_forge = k_flood = None
+    if scenario is not None and scenario.has_adversary:
+        # ONE fold of the registered adversary salt per round (the
+        # lineage contract: a (parent, salt) pair folds once), split into
+        # the three per-round attack children — all consumed at GLOBAL
+        # shape, so adversarial rounds keep the local↔sharded
+        # bit-identity contract
+        from tpu_gossip.core.streams import ADVERSARY_STREAM_SALT
+
+        k_accuse, k_forge, k_flood = jax.random.split(
+            jax.random.fold_in(state.rng, ADVERSARY_STREAM_SALT), 3
+        )
     if scenario is None:
         incoming, msgs_sent = disseminate(
             transmit, transmitter, receptive, k_push, k_pull, rctl
@@ -645,6 +793,7 @@ def run_protocol_round(
                 lambda tx, tr, rc, kp, kq: disseminate(
                     tx, tr, rc, kp, kq, rctl
                 ),
+                k_flood=k_flood,
             )
         )
     pipe_buf = None
@@ -660,4 +809,9 @@ def run_protocol_round(
         churn_faults=scenario is not None and scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth, stream=stream,
         control=control, rctl=rctl, pipe_buf=pipe_buf,
+        liveness=liveness,
+        has_accusers=scenario is not None and scenario.has_accusers,
+        has_forgers=scenario is not None and scenario.has_forgers,
+        forge_width=scenario.max_forge_fanout if scenario is not None else 0,
+        k_accuse=k_accuse, k_forge=k_forge,
     )
