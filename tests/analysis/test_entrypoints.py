@@ -50,6 +50,10 @@ def test_matrix_structural_coverage():
     assert "local[matching,stream]" in names and "local[pallas,stream]" in names
     assert "local[matching,control]" in names and "local[pallas,control]" in names
     assert "local[simulate]" in names and "local[run_until_coverage]" in names
+    # the PACKED loop entries (core/packed.py): packed carries must be
+    # fixed points too, and the mem tier prices the packed residency
+    assert "local[simulate,packed]" in names
+    assert "local[run_until_coverage,packed]" in names
     # the batched fleet entry (fleet/): composed campaign at batch rank
     assert "fleet[simulate,composed]" in names
     # dist half (present on this 8-device test host)
@@ -64,6 +68,7 @@ def test_matrix_structural_coverage():
         "dist[matching,pipeline]", "dist[bucketed,pipeline]",
         "dist[matching,pipeline+scenario+stream]",
         "dist[matching,adversary+scenario]",
+        "dist[matching,simulate,packed]",
     ):
         assert n in names, n
 
@@ -97,7 +102,9 @@ def test_every_entry_declares_n_peers():
     for ep in EPS:
         assert ep.n_peers > 0, f"{ep.name}: n_peers undeclared"
         _, st = ep.build()
-        slots = int(np.prod(st.alive.shape))
+        # packed entries carry the six masks in the shared flags word
+        lead = st.alive.shape if hasattr(st, "alive") else st.flags.shape
+        slots = int(np.prod(lead))
         assert slots == ep.n_peers, (
             f"{ep.name}: declared n_peers={ep.n_peers} but the built "
             f"state has {slots} slots"

@@ -352,16 +352,26 @@ def test_suspicion_cursor_checkpoint_roundtrip_mid_window():
 def test_pre_adversarial_checkpoint_loads_planes_zeroed(tmp_path):
     """A checkpoint written before the suspicion planes existed loads
     with them zeroed — no suspicion, no strikes, nobody quarantined."""
+    import dataclasses as _dc
+
+    import jax as _jax
+
     cfg = SwarmConfig(n_peers=64, msg_slots=4, fanout=2, mode="push")
     st = _state(cfg, graph=_graph(64))
     p = tmp_path / "old.npz"
-    save_swarm(p, st)
-    # strip the new planes from the archive: the pre-PR format
-    data = dict(np.load(p))
-    for f in ("field_suspect_round", "field_suspect_mark",
-              "field_quarantine"):
-        del data[f]
-    np.savez(p, **data)
+    # write the PRE-PACKING named layout directly (every plane unpacked —
+    # what the old save_swarm emitted; since the packed-plane PR the
+    # current writer stores quarantine as a flags bit, so stripping it
+    # from a fresh archive is no longer expressible), then strip the
+    # suspicion planes: the pre-adversarial, pre-packing format
+    arrays = {}
+    for f in _dc.fields(type(st)):
+        leaf = getattr(st, f.name)
+        if f.name == "rng":
+            arrays["prngkey_rng"] = np.asarray(_jax.random.key_data(leaf))
+        elif f.name not in ("suspect_round", "suspect_mark", "quarantine"):
+            arrays[f"field_{f.name}"] = np.asarray(leaf)
+    np.savez(p, **arrays)
     loaded = load_swarm(p)
     assert (np.asarray(loaded.suspect_round) == -1).all()
     assert (np.asarray(loaded.suspect_mark) == 0).all()

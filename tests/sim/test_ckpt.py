@@ -92,11 +92,25 @@ def test_sharded_roundtrip_is_bit_exact(tmp_path, warm_state):
         np.testing.assert_array_equal(stats2[f], arr)
     assert manifest["round"] == 6 and manifest["shards"] == 4
     assert manifest["run"] == {"peers": 96}
-    # the manifest declares every plane at its registry dtype
-    reg = {p.name: p.dtype for p in PLANES}
+    # the manifest declares every plane at its registry STORAGE dtype:
+    # packed "bits" planes and the shared flags word land as uint8, the
+    # six flag planes collapse into it, everything else keeps its
+    # registry compute dtype (the packed-plane PR's format-3 contract)
+    reg = {p.name: p for p in PLANES}
+    assert manifest["format"] == 3
+    assert manifest["planes"]["flags"]["dtype"] == "uint8"
     for name, entry in manifest["planes"].items():
-        if reg[name] != "key":
-            assert entry["dtype"] == reg[name], name
+        if name == "flags":
+            continue
+        spec = reg[name]
+        assert spec.packed is None or spec.packed == "bits", name
+        if spec.dtype == "key":
+            continue
+        want = "uint8" if spec.packed == "bits" else spec.dtype
+        assert entry["dtype"] == want, name
+    for p in PLANES:
+        if p.packed is not None and p.packed.startswith("flag:"):
+            assert p.name not in manifest["planes"], p.name
 
 
 def test_shard_count_is_a_storage_choice(tmp_path, warm_state):
@@ -359,9 +373,11 @@ def test_load_swarm_names_the_broken_plane(tmp_path):
 
     save_swarm(path, st)
     data = dict(np.load(path))
-    data["field_alive"] = data["field_alive"][:16]
+    # the six (N,) masks ride the shared packed flags word now — a
+    # truncated word surfaces as a named shape error on a flag plane
+    data["field_flags"] = data["field_flags"][:16]
     np.savez(path, **data)
-    with pytest.raises(ValueError, match="'alive'.*shape"):
+    with pytest.raises(ValueError, match="'exists'.*shape"):
         load_swarm(path)
 
 

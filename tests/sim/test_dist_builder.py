@@ -1,0 +1,193 @@
+"""dist/builder.py — the born-distributed matching builder's contracts.
+
+The conformance contract (the checkpoint resharding contract run
+forward): ``matching_powerlaw_graph_dist`` built inside ``shard_map``
+must be BIT-IDENTICAL on every plan leaf and graph array to the local
+``matching_powerlaw_graph_sharded(..., block_keys=True)`` layout truth —
+tables, erasure survivors, degree tables, the CSR, the exists mask. Plus:
+rounds on the born-distributed layout run bit-identical local vs mesh
+(the existing engine contract, on the new layout), growth composes, and
+the narrow degree tables hold their declared dtype.
+
+Builds are shared module-wide (each (rows, classes) shape is a fresh
+jit compile); the CI builder-smoke job runs this file INCLUDING the
+slow-marked growing run.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip.core.matching_topology import (
+    DEG_TABLE_CAP,
+    matching_powerlaw_graph_sharded,
+    plan_table_widths,
+)
+from tpu_gossip.core.state import SwarmConfig, clone_state, init_swarm
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from tpu_gossip.dist import make_mesh
+
+    mesh = make_mesh()
+    if 128 % mesh.size:
+        pytest.skip(f"mesh size {mesh.size} does not divide 128")
+    return mesh
+
+
+@pytest.fixture(scope="module")
+def builds(mesh):
+    """One (local block-keyed, dist-native) build pair at n=256, shared
+    by the conformance and round-contract tests."""
+    from tpu_gossip.dist import matching_powerlaw_graph_dist
+
+    g1, p1 = matching_powerlaw_graph_sharded(
+        256, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(3),
+        block_keys=True,
+    )
+    g2, p2 = matching_powerlaw_graph_dist(
+        256, mesh, gamma=2.5, fanout=1, key=jax.random.key(3),
+    )
+    return g1, p1, g2, p2
+
+
+def test_dist_build_bit_identical_to_block_keys_local(builds):
+    g1, p1, g2, p2 = builds
+    assert p1.classes == p2.classes
+    assert p1.local_classes == p2.local_classes
+    assert (p1.n, p1.rows, p1.n_per, p1.n_blk, p1.per_rows,
+            p1.mesh_shards) == (p2.n, p2.rows, p2.n_per, p2.n_blk,
+                                p2.per_rows, p2.mesh_shards)
+    for name in ("m3", "valid", "deg_other", "deg_real"):
+        a, b = getattr(p1, name), getattr(p2, name)
+        assert a.dtype == b.dtype, name
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+    for group in ("lanes", "lanes_inv"):
+        for i, (a, b) in enumerate(zip(getattr(p1, group),
+                                       getattr(p2, group))):
+            assert a.dtype == b.dtype
+            assert (np.asarray(a) == np.asarray(b)).all(), (group, i)
+    assert (np.asarray(g1.row_ptr) == np.asarray(g2.row_ptr)).all()
+    assert (np.asarray(g1.col_idx) == np.asarray(g2.col_idx)).all()
+    assert (np.asarray(g1.exists) == np.asarray(g2.exists)).all()
+    # the born-distributed arrays land placed on the mesh's peer axis
+    assert "peers" in str(p2.valid.sharding)
+
+
+@pytest.mark.slow
+def test_rounds_on_born_distributed_layout_local_vs_mesh(mesh, builds):
+    """The engine bit-identity contract holds on the new layout: the
+    born-distributed plan runs the mesh round bit-identical to the local
+    round on the block-keyed twin. (Slow-marked: two engine compiles on
+    top of the shared builds; the CI builder-smoke job runs it on every
+    push — the tier-1 pin is the leaf-equality conformance above, which
+    the engine contract then inherits: both engines already run
+    bit-identically on ANY shared plan.)"""
+    from tpu_gossip.dist import (
+        shard_matching_plan,
+        shard_swarm,
+        simulate_dist,
+    )
+    from tpu_gossip.sim.engine import simulate
+
+    gl, pl, gd, pd = builds
+    cfg = SwarmConfig(n_peers=pd.n, msg_slots=16, fanout=1,
+                      mode="push_pull")
+    st = init_swarm(gd.as_padded_graph(), cfg, origins=[0],
+                    exists=gd.exists, key=jax.random.key(0))
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg,
+        shard_matching_plan(pd, mesh), mesh, 6,
+    )
+    stl = init_swarm(gl.as_padded_graph(), cfg, origins=[0],
+                     exists=gl.exists, key=jax.random.key(0))
+    fin_l, stats_l = simulate(stl, cfg, 6, pl)
+    for f in dataclasses.fields(type(fin_l)):
+        a, b = getattr(fin_l, f.name), getattr(fin_d, f.name)
+        if f.name == "rng":
+            assert (jax.random.key_data(a) == jax.random.key_data(b)).all()
+        else:
+            assert (np.asarray(a) == np.asarray(b)).all(), f.name
+    for name, a, b in zip(stats_l._fields, stats_l, stats_d):
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+
+
+@pytest.mark.slow
+def test_growing_dist_build_conformance_and_run(mesh):
+    """Growth capacity rows: the dist build stays bit-identical to the
+    block-keyed local truth WITH reserved rows, and the shared growth
+    engine admits into them on the mesh. (Slow-marked: two more builds
+    + a growing mesh compile; the CI builder-smoke job runs it.)"""
+    from tpu_gossip.dist import (
+        matching_powerlaw_graph_dist,
+        shard_matching_plan,
+        shard_swarm,
+        simulate_dist,
+    )
+    from tpu_gossip.growth import compile_growth, matching_admit_rows
+
+    grow_rows = 8
+    g1, p1 = matching_powerlaw_graph_sharded(
+        256, mesh.size, gamma=2.5, fanout=2, key=jax.random.key(5),
+        block_keys=True, growth_rows=grow_rows,
+    )
+    gd, pd = matching_powerlaw_graph_dist(
+        256, mesh, gamma=2.5, fanout=2, key=jax.random.key(5),
+        growth_rows=grow_rows,
+    )
+    for name in ("valid", "deg_other", "deg_real"):
+        assert (np.asarray(getattr(p1, name))
+                == np.asarray(getattr(pd, name))).all(), name
+    assert (np.asarray(g1.row_ptr) == np.asarray(gd.row_ptr)).all()
+    assert (np.asarray(g1.col_idx) == np.asarray(gd.col_idx)).all()
+
+    cfg = SwarmConfig(n_peers=pd.n, msg_slots=16, fanout=2,
+                      mode="push_pull", rewire_slots=2)
+    st = init_swarm(gd.as_padded_graph(), cfg, origins=[0],
+                    exists=gd.exists, key=jax.random.key(0))
+    n0 = int(np.asarray(st.exists).sum())
+    target = n0 + mesh.size * grow_rows
+    gp = compile_growth(
+        n_initial=n0, target=target, n_slots=pd.n, joins_per_round=8,
+        attach_m=2,
+        admit_rows=matching_admit_rows(pd, target - n0),
+    )
+    fin, stats = simulate_dist(
+        shard_swarm(st, mesh), cfg, shard_matching_plan(pd, mesh), mesh,
+        12, growth=gp,
+    )
+    assert int(np.asarray(fin.exists).sum()) == target
+    assert int(np.asarray(stats.n_members)[-1]) == target
+
+
+@pytest.mark.slow
+def test_dist_build_csr_free_row_ptr_identical(mesh):
+    from tpu_gossip.dist import matching_powerlaw_graph_dist
+
+    g1, _p1 = matching_powerlaw_graph_sharded(
+        256, mesh.size, fanout=1, key=jax.random.key(1), block_keys=True,
+        export_csr=False,
+    )
+    g2, _p2 = matching_powerlaw_graph_dist(
+        256, mesh, fanout=1, key=jax.random.key(1), export_csr=False,
+    )
+    assert (np.asarray(g1.row_ptr) == np.asarray(g2.row_ptr)).all()
+    assert g2.col_idx.shape == (1,)  # the CSR-free sentinel shape
+
+
+def test_degree_tables_declared_narrow(builds):
+    """The registry-declared int16 degree tables land when d_max fits the
+    cap (every tracked scale) and stay int32 when it cannot."""
+    _g1, p, _g2, _p2 = builds
+    assert str(p.deg_other.dtype) == "int16"
+    assert str(p.deg_real.dtype) == "int16"
+    assert int(np.asarray(p.deg_other).max()) <= DEG_TABLE_CAP
+    w = plan_table_widths(1_000_000, n_shards=8)
+    assert w["deg_other"]["dtype"] == "int16"
+    assert w["lanes"]["dtype"] == "int8"
+    # past the cap the declaration widens (d_max > 32767)
+    w100 = plan_table_widths(100_000_000, n_shards=8)
+    assert w100["deg_other"]["dtype"] == "int32"

@@ -1,0 +1,296 @@
+"""Packed runs are BIT-IDENTICAL to unpacked runs — the tentpole contract.
+
+The packed entry points keep the scan/while carry as the registry's
+packed storage ledger and run each round as unpack -> the identical
+round program -> repack, so equality here is strong evidence the codec
+is exact AND that nothing in the round path leaks representation. The
+matrix cells below compose every optional plane (chaos scenario, growth,
+stream, control, quorum/adversary, pipeline) on the local engine and the
+sharded matching mesh; the durability half pins packed checkpoints
+against both legacy formats and the sharded store.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip.analysis.entrypoints import (
+    _chaos_scenario,
+    _control_plan,
+    _growth_plan,
+    _quorum_spec,
+    _stream_plan,
+)
+from tpu_gossip.core.packed import PackedSwarm, pack_state, unpack_state
+from tpu_gossip.core.state import (
+    SwarmConfig,
+    clone_state,
+    init_swarm,
+    load_swarm,
+    save_swarm,
+)
+from tpu_gossip.core.topology import (
+    build_csr,
+    configuration_model,
+    powerlaw_degree_sequence,
+)
+from tpu_gossip.sim.engine import run_until_coverage, simulate
+
+N = 300
+
+
+def _graph(n=N):
+    rng = np.random.default_rng(0)
+    return build_csr(
+        n, configuration_model(
+            powerlaw_degree_sequence(n, gamma=2.5, rng=rng), rng=rng
+        )
+    )
+
+
+def _assert_states_equal(a, b, where=""):
+    for f in dataclasses.fields(type(a)):
+        x, y = getattr(a, f.name), getattr(b, f.name)
+        if f.name == "rng":
+            assert (jax.random.key_data(x) == jax.random.key_data(y)).all()
+        else:
+            assert bool((x == y).all()), f"{where}: {f.name}"
+
+
+def _assert_stats_equal(a, b, where=""):
+    for name, x, y in zip(a._fields, a, b):
+        assert bool((np.asarray(x) == np.asarray(y)).all()), f"{where}: {name}"
+
+
+# ----------------------------------------------------- local composed matrix
+def test_packed_simulate_bit_identical_maximal_cell():
+    """Packed vs unpacked `simulate` on ONE maximal composed cell —
+    chaos faults (loss + delay + blackout) AND Byzantine attacks in the
+    scenario, growth, stream, control, and the quorum detector all
+    active, full final state + every per-round stat bit for bit. One
+    compile pair covers every optional stage's packed carry (a plain
+    cell is subsumed by the coverage-loop test below; the pipelined
+    swap is pinned by the mesh composed cell)."""
+    from tpu_gossip.faults import compile_scenario, scenario_from_dict
+
+    g = _graph()
+    sc = compile_scenario(scenario_from_dict({
+        "name": "packed-maximal",
+        "phases": [
+            {"name": "lossy", "start": 0, "end": 3, "loss": 0.2,
+             "delay": 0.2},
+            {"name": "siege", "start": 3, "end": 7,
+             "accusers": {"frac": 0.05, "seed": 3},
+             "forgers": {"frac": 0.02, "seed": 4},
+             "floods": {"frac": 0.03, "seed": 5},
+             "blackout": {"frac": 0.1, "seed": 2},
+             "forge_fanout": 2, "flood_fanout": 3},
+        ],
+    }), n_peers=N, n_slots=N, total_rounds=8)
+    kw = dict(
+        scenario=sc,
+        growth=_growth_plan(N, N - 40),
+        stream=_stream_plan(16, np.ones(N, bool)),
+        control=_control_plan(ttl=8),
+        liveness=_quorum_spec(),
+    )
+    cfg = SwarmConfig(n_peers=N, msg_slots=16, fanout=1, mode="push_pull",
+                      churn_join_prob=0.02, churn_leave_prob=0.002,
+                      rewire_slots=2)
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(2))
+    fin_u, stats_u = simulate(clone_state(st), cfg, 8, **kw)
+    fin_p, stats_p = simulate(pack_state(st), cfg, 8, **kw)
+    assert isinstance(fin_p, PackedSwarm)
+    _assert_states_equal(fin_u, unpack_state(fin_p), "maximal")
+    _assert_stats_equal(stats_u, stats_p, "maximal")
+
+
+def test_packed_coverage_loop_bit_identical():
+    g = _graph()
+    cfg = SwarmConfig(n_peers=N, msg_slots=16, fanout=2, mode="push_pull",
+                      sir_recover_rounds=6)
+    st = init_swarm(g, cfg, origins=[0, 1], key=jax.random.key(1))
+    fin_u = run_until_coverage(clone_state(st), cfg, 0.95, 60)
+    fin_p = run_until_coverage(pack_state(st), cfg, 0.95, 60)
+    fin_pu = unpack_state(fin_p)
+    assert int(fin_u.round) == int(fin_pu.round)
+    _assert_states_equal(fin_u, fin_pu, "coverage")
+
+
+# ------------------------------------------------------------ the mesh half
+@pytest.fixture(scope="module")
+def mesh_fixture():
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.dist import make_mesh, shard_matching_plan, shard_swarm
+
+    mesh = make_mesh()
+    if 128 % mesh.size:
+        pytest.skip(f"mesh size {mesh.size} does not divide 128")
+    dg, plan = matching_powerlaw_graph_sharded(
+        256, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1,
+                      mode="push_pull")
+    st = init_swarm(dg.as_padded_graph(), cfg, origins=[0],
+                    exists=dg.exists, key=jax.random.key(0))
+    return mesh, plan, cfg, shard_swarm(st, mesh), shard_matching_plan(
+        plan, mesh
+    )
+
+
+def test_packed_dist_matching_bit_identical_composed(mesh_fixture):
+    """Packed vs unpacked `simulate_dist` on the matching mesh with
+    scenario + stream + pipeline composed — the packed carry keeps the
+    peer-axis sharding and the mesh trajectory bit for bit."""
+    from tpu_gossip.dist import simulate_dist
+    from tpu_gossip.sim.stages import compile_pipeline
+
+    mesh, plan, cfg, st, splan = mesh_fixture
+    kw = dict(
+        scenario=_chaos_scenario(plan.n, 256),
+        stream=_stream_plan(16, np.asarray(st.exists)),
+        pipeline=compile_pipeline(1),
+    )
+    fin_u, stats_u = simulate_dist(clone_state(st), cfg, splan, mesh, 6,
+                                   **kw)
+    # pack a CLONE: pack_state aliases the pass-through leaves (row_ptr,
+    # infected_round, ...), so donating the packed pytree would delete
+    # the module fixture's buffers under the next test
+    p = pack_state(clone_state(st))
+    # the packed pytree keeps the peer-axis sharding (row-parallel codec)
+    assert "peers" in str(p.seen.sharding)
+    fin_p, stats_p = simulate_dist(p, cfg, splan, mesh, 6, **kw)
+    _assert_states_equal(fin_u, unpack_state(fin_p), "dist")
+    _assert_stats_equal(stats_u, stats_p, "dist")
+
+
+@pytest.mark.slow
+def test_packed_dist_coverage_loop(mesh_fixture):
+    """(Slow-marked: two more while-loop compiles; the local coverage
+    twin and the packed dist scan above carry the tier-1 pin.)"""
+    from tpu_gossip.dist import run_until_coverage_dist
+
+    mesh, _plan, cfg, st, splan = mesh_fixture
+    fin_u = run_until_coverage_dist(clone_state(st), cfg, splan, mesh,
+                                    0.9, 40)
+    fin_p = run_until_coverage_dist(pack_state(clone_state(st)), cfg,
+                                    splan, mesh, 0.9, 40)
+    _assert_states_equal(fin_u, unpack_state(fin_p), "dist-coverage")
+
+
+# -------------------------------------------------- packed-plane durability
+def test_pre_packing_named_npz_loads_losslessly(tmp_path):
+    """A pre-packing (unpacked-plane) named npz — the format every
+    checkpoint on disk before this PR uses — loads bit-losslessly, and
+    packing the loaded state round-trips."""
+    g = _graph(64)
+    cfg = SwarmConfig(n_peers=64, msg_slots=8, fanout=2)
+    st = init_swarm(g, cfg, origins=[3], key=jax.random.key(9))
+    path = tmp_path / "old.npz"
+    arrays = {}
+    for f in dataclasses.fields(type(st)):
+        leaf = getattr(st, f.name)
+        if f.name == "rng":
+            arrays["prngkey_rng"] = np.asarray(jax.random.key_data(leaf))
+        else:
+            arrays[f"field_{f.name}"] = np.asarray(leaf)
+    np.savez(path, **arrays)  # the OLD writer's layout, verbatim
+    loaded = load_swarm(path)
+    _assert_states_equal(st, loaded, "pre-packing npz")
+    _assert_states_equal(st, unpack_state(pack_state(loaded)), "repack")
+
+
+def test_packed_npz_roundtrip_and_smaller(tmp_path):
+    g = _graph(64)
+    cfg = SwarmConfig(n_peers=64, msg_slots=16, fanout=2)
+    st = init_swarm(g, cfg, origins=[3], key=jax.random.key(9))
+    new = tmp_path / "new.npz"
+    save_swarm(new, st)
+    _assert_states_equal(st, load_swarm(new), "packed npz")
+    # the packed payload stores the five bit planes + flags word packed
+    data = np.load(new)
+    assert data["field_seen"].dtype == np.uint8
+    assert data["field_seen"].shape == (64, 2)
+    assert data["field_flags"].dtype == np.uint8
+    assert "field_alive" not in data.files
+
+
+def test_pre_packing_sharded_checkpoint_loads(tmp_path):
+    """A format-2 (unpacked) sharded-store checkpoint — written here with
+    the old plane layout and a format-2 manifest — loads bit-losslessly
+    through the format-3 reader."""
+    import hashlib
+    import io
+
+    from tpu_gossip.ckpt.store import load_checkpoint
+
+    g = _graph(60)
+    cfg = SwarmConfig(n_peers=60, msg_slots=8, fanout=2)
+    st = init_swarm(g, cfg, origins=[2], key=jax.random.key(5))
+    host = {}
+    for f in dataclasses.fields(type(st)):
+        leaf = getattr(st, f.name)
+        host[f.name] = (
+            np.asarray(jax.random.key_data(leaf)) if f.name == "rng"
+            else np.asarray(leaf)
+        )
+    ck = tmp_path / "ckpt-00000003"
+    ck.mkdir(parents=True)
+    files = {}
+
+    def put(name, arrays):
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        (ck / name).write_bytes(payload)
+        files[name] = {"sha256": hashlib.sha256(payload).hexdigest(),
+                       "bytes": len(payload)}
+        return files[name]
+
+    from tpu_gossip.ckpt.store import _global_planes, _row_planes
+
+    rp = host["row_ptr"]
+    shard = {f"rows_{p}": host[p] for p in _row_planes(packed=False)}
+    shard["rows_row_ptr"] = rp
+    shard["rows_col_idx"] = host["col_idx"][: int(rp[-1])]
+    put("shard-00000-of-00001.npz", shard)["rows"] = [0, 60]
+    gl = {f"field_{p}": host[p] for p in _global_planes() if p != "rng"}
+    gl["prngkey_rng"] = host["rng"]
+    gl["col_tail"] = host["col_idx"][int(rp[-1]):]
+    put("global.npz", gl)
+    manifest = {
+        "format": 2, "kind": "run", "round": 3, "files": files,
+        "n_peers": 60, "msg_slots": 8, "shards": 1,
+        "planes": {},
+    }
+    (ck / "MANIFEST.json").write_text(json.dumps(manifest))
+    loaded, _stats, mf = load_checkpoint(ck)
+    assert mf["format"] == 2
+    _assert_states_equal(st, loaded, "format-2 store")
+
+
+def test_packed_store_roundtrip_bit_exact_and_resharded(tmp_path):
+    """The format-3 (packed) store round-trips bit-exactly at any file
+    shard count — packing is along the slot axis, so row slicing
+    commutes with it — and accepts a PackedSwarm directly (the packed
+    driver's periodic-save path)."""
+    from tpu_gossip.ckpt.store import load_checkpoint, save_checkpoint
+
+    g = _graph(96)
+    cfg = SwarmConfig(n_peers=96, msg_slots=16, fanout=2, mode="push_pull",
+                      churn_join_prob=0.02, churn_leave_prob=0.01,
+                      rewire_slots=2)
+    st = init_swarm(g, cfg, origins=[0], key=jax.random.key(3))
+    st, _ = simulate(st, cfg, 5)
+    for s, state_in in ((1, st), (3, st), (4, pack_state(st))):
+        d = tmp_path / f"s{s}"
+        save_checkpoint(d, state_in, step=5, shards=s)
+        loaded, _, mf = load_checkpoint(d / "ckpt-00000005")
+        assert mf["format"] == 3 and mf["msg_slots"] == 16
+        _assert_states_equal(st, loaded, f"s={s}")

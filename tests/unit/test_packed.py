@@ -1,0 +1,159 @@
+"""core/packed.py — the bit-true storage codec.
+
+Pins: the LSB-first bit-order contract (a hand-computed vector, so the
+encoding can never silently flip), exact pack/unpack inversion on odd
+widths, the numpy twins matching the jnp codec byte for byte (the
+checkpoint stores depend on it), the packed coverage accessor, and the
+registry's packed pricing arithmetic (142 -> 67 B/peer at the headline
+shape).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_gossip.core.packed import (
+    BIT_PLANES,
+    FLAG_BITS,
+    FLAG_PLANES,
+    bit_column,
+    np_pack_bits,
+    np_pack_flags,
+    np_unpack_bits,
+    np_unpack_flag,
+    pack_bits,
+    pack_flags,
+    pack_state,
+    unpack_bits,
+    unpack_flag,
+    unpack_state,
+)
+from tpu_gossip.core.state import (
+    PLANES,
+    SwarmConfig,
+    init_swarm,
+    state_bytes_per_peer,
+    state_plane_bytes,
+)
+from tpu_gossip.core.topology import (
+    build_csr,
+    configuration_model,
+    powerlaw_degree_sequence,
+)
+
+
+def _state(n=200, m=13, **cfg_kw):
+    rng = np.random.default_rng(0)
+    g = build_csr(
+        n, configuration_model(
+            powerlaw_degree_sequence(n, gamma=2.5, rng=rng), rng=rng
+        )
+    )
+    cfg = SwarmConfig(n_peers=n, msg_slots=m, fanout=2, **cfg_kw)
+    st = init_swarm(g, cfg, origins=[0, 3], key=jax.random.key(1))
+    st.silent = st.silent.at[5].set(True)
+    st.recovered = st.recovered.at[7, m - 1].set(True)
+    return st
+
+
+def test_bit_order_is_lsb_first_pinned():
+    """The encoding contract: bit k of word j holds slot 8*j + k. A
+    hand-computed vector — if this flips, every checkpoint on disk
+    becomes unreadable, so it is a pinned constant, not a convention."""
+    x = jnp.asarray([[True, False, True, False, False, False, False, False,
+                      True]])  # slots 0,2 -> 0b101 = 5; slot 8 -> word 1
+    words = pack_bits(x)
+    assert words.dtype == jnp.uint8 and words.shape == (1, 2)
+    assert words.tolist() == [[5, 1]]
+    back = unpack_bits(words, 9)
+    assert bool((back == x).all())
+
+
+@pytest.mark.parametrize("m", [1, 7, 8, 9, 16, 33])
+def test_pack_unpack_roundtrip_odd_widths(m):
+    rng = np.random.default_rng(m)
+    x = jnp.asarray(rng.random((37, m)) < 0.3)
+    words = pack_bits(x)
+    assert words.shape == (37, -(-m // 8)) and words.dtype == jnp.uint8
+    assert bool((unpack_bits(words, m) == x).all())
+    # numpy twin: byte-for-byte the same words (the store's codec)
+    assert (np.asarray(words) == np_pack_bits(np.asarray(x))).all()
+    assert (np_unpack_bits(np.asarray(words), m) == np.asarray(x)).all()
+
+
+def test_flags_word_roundtrip_and_bit_assignment():
+    rng = np.random.default_rng(7)
+    planes = {n: jnp.asarray(rng.random(50) < 0.4) for n in FLAG_PLANES}
+    word = pack_flags(planes)
+    assert word.dtype == jnp.uint8
+    for name, bit in FLAG_BITS.items():
+        assert bool((unpack_flag(word, name) == planes[name]).all())
+        # the bit assignment is a stored-format constant
+        assert ((np.asarray(word) >> bit) & 1
+                == np.asarray(planes[name])).all(), name
+    npw = np_pack_flags({n: np.asarray(v) for n, v in planes.items()})
+    assert (np.asarray(word) == npw).all()
+    for name in FLAG_PLANES:
+        assert (np_unpack_flag(npw, name) == np.asarray(planes[name])).all()
+
+
+def test_state_roundtrip_exact():
+    st = _state(m=13, churn_join_prob=0.02, churn_leave_prob=0.01,
+                rewire_slots=2)
+    p = pack_state(st)
+    assert p.msg_slots == 13
+    st2 = unpack_state(p)
+    for f in dataclasses.fields(type(st)):
+        a, b = getattr(st, f.name), getattr(st2, f.name)
+        if f.name == "rng":
+            assert (jax.random.key_data(a) == jax.random.key_data(b)).all()
+        else:
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert bool((a == b).all()), f.name
+
+
+def test_packed_coverage_matches_unpacked():
+    st = _state(m=16)
+    p = pack_state(st)
+    for slot in (0, 7, 15):
+        assert float(p.coverage(slot)) == float(st.coverage(slot))
+    assert bool((bit_column(p.seen, 0) == st.seen[:, 0]).all())
+
+
+def test_every_bit_and_flag_plane_is_registry_declared():
+    """The codec's membership derives from the PLANES registry — a plane
+    packed here but not declared there (or vice versa) is a drift the
+    mem tier and the checkpoint format would disagree about."""
+    reg = {p.name: p.packed for p in PLANES}
+    assert set(BIT_PLANES) == {n for n, v in reg.items() if v == "bits"}
+    assert set(FLAG_PLANES) == {
+        n for n, v in reg.items() if v is not None and v.startswith("flag:")
+    }
+    # flag bit indices match the registry's flag:<k> declarations
+    for name, bit in FLAG_BITS.items():
+        assert reg[name] == f"flag:{bit}"
+
+
+def test_packed_pricing_arithmetic():
+    """Hand sums at (N=100, M=16): bits planes cost ceil(M/8) B/row, the
+    six flag planes one shared byte, everything else unchanged — and the
+    headline figure lands at 67 B/peer (was 142)."""
+    by_plane = state_plane_bytes(100, 16, packed=True)
+    assert by_plane["seen"] == 100 * 2
+    assert by_plane["fault_held"] == 100 * 2
+    assert by_plane["infected_round"] == 100 * 16 * 2  # not packable
+    assert by_plane["exists"] == 100  # the shared flags byte, charged once
+    for other in ("alive", "silent", "declared_dead", "rewired",
+                  "quarantine"):
+        assert by_plane[other] == 0
+    assert by_plane["last_hb"] == 100 * 2
+    # odd widths round the word count up
+    assert state_plane_bytes(100, 13, packed=True)["seen"] == 100 * 2
+    assert state_plane_bytes(100, 17, packed=True)["seen"] == 100 * 3
+    assert state_bytes_per_peer(1_000_000, 16) == pytest.approx(142.0, abs=0.01)
+    assert state_bytes_per_peer(1_000_000, 16, packed=True) == pytest.approx(
+        67.0, abs=0.01
+    )

@@ -143,6 +143,52 @@ def run_repo_lint(
     return out
 
 
+def _print_planes(args) -> int:
+    """The --planes table: every PLANES entry priced at the given (n, m)
+    — compute dtype, info bits, packed storage encoding, unpacked and
+    packed B/peer — plus the matching family's declared plan-table
+    widths. Pure registry/host arithmetic: no arrays are built, so the
+    packing headroom is inspectable at 100M without reading state.py."""
+    try:
+        n, m = (int(x) for x in args.planes_shape.split(","))
+    except ValueError:
+        print(f"--planes-shape wants N,M; got {args.planes_shape!r}",
+              file=sys.stderr)
+        return 2
+    from tpu_gossip.core.matching_topology import plan_table_widths
+    from tpu_gossip.core.state import (
+        PLANES, state_plane_bytes, state_bytes_per_peer,
+    )
+
+    unpacked = state_plane_bytes(n, m)
+    packed = state_plane_bytes(n, m, packed=True)
+    print(f"PLANES registry priced at N={n:,} M={m} "
+          f"(core/state.py; storage codec core/packed.py)")
+    hdr = (f"{'plane':<16} {'dtype':<6} {'shape':<8} {'bits':>4} "
+           f"{'storage':<7} {'B/peer':>9} {'packed':>9} {'saved':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    for p in PLANES:
+        u = unpacked[p.name] / n
+        q = packed[p.name] / n
+        print(f"{p.name:<16} {p.dtype:<6} {p.shape:<8} {p.info_bits:>4} "
+              f"{(p.packed or '-'):<7} {u:>9.3f} {q:>9.3f} {u - q:>8.3f}")
+    tot_u = state_bytes_per_peer(n, m)
+    tot_p = state_bytes_per_peer(n, m, packed=True)
+    print("-" * len(hdr))
+    print(f"{'TOTAL':<16} {'':<6} {'':<8} {'':>4} {'':<7} "
+          f"{tot_u:>9.3f} {tot_p:>9.3f} {tot_u - tot_p:>8.3f}")
+    print(f"\nmatching plan tables at N={n:,}, "
+          f"{args.planes_shards} shards (declared widths, saturating at "
+          f"DEG_TABLE_CAP; core/matching_topology.py):")
+    for name, row in plan_table_widths(
+        n, n_shards=args.planes_shards
+    ).items():
+        print(f"  {name:<10} {row['dtype']:<6} {row['shape']:<18} "
+              f"{row['bytes'] / 1e6:>10.2f} MB  {row['why']}")
+    return 0
+
+
 def _ensure_multi_device_env() -> None:
     """Give the contract audit its 8-CPU mesh: XLA reads XLA_FLAGS at
     backend CREATION, which is lazy — so setting it here works even though
@@ -231,12 +277,30 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit"
     )
+    ap.add_argument(
+        "--planes", action="store_true",
+        help="print the priced PLANES registry table (dtype, info bits, "
+        "packed storage, B/peer at --planes-shape) plus the matching "
+        "family's declared plan-table widths, then exit — the packing "
+        "headroom without reading state.py",
+    )
+    ap.add_argument(
+        "--planes-shape", default="1000000,16", metavar="N,M",
+        help="swarm shape the --planes table prices (default 1000000,16)",
+    )
+    ap.add_argument(
+        "--planes-shards", type=int, default=8, metavar="S",
+        help="mesh size for the --planes matching-table ledger (default 8)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid in sorted(RULES):
             print(rid)
         return 0
+
+    if args.planes:
+        return _print_planes(args)
 
     root = repo_root()
     only = (

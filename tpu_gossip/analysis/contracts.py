@@ -186,9 +186,13 @@ def _check_matrix_entries(check_name: str) -> list:
         _diff_specs(name, _spec_tree(out_st), _spec_tree(te.state), problems)
         if out_stats is not None:
             # msg_slots is the seen plane's LAST axis — (N, M) solo,
-            # (K, N, M) at batch rank (the fleet entry)
+            # (K, N, M) at batch rank (the fleet entry); a PACKED entry's
+            # seen plane holds uint8 words, so its true M rides the
+            # static msg_slots field instead
+            m = getattr(te.state, "msg_slots", None) or \
+                te.state.seen.shape[-1]
             _stats_contract(out_stats, problems, leading=ep.stats_leading,
-                            msg_slots=te.state.seen.shape[-1])
+                            msg_slots=m)
         if ici is not None:
             _ici_contract(name, ici, problems)
     return problems

@@ -588,6 +588,41 @@ def _local_entries() -> list[EntryPoint]:
         n_peers=ctx["dg"].n_pad,
     ))
 
+    # PACKED loop entries (core/packed.py): the scan/while carry is the
+    # registry's packed storage ledger — the packed pytree must be a
+    # fixed point of the packed round map (or a packed carry could never
+    # ride the loops/checkpoints), the donating jit must cover every
+    # packed leaf, and the mem tier prices the packed residency
+    def build_sim_packed():
+        from tpu_gossip.core.packed import pack_state
+
+        st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
+        return (lambda s: engine.simulate(s, cfg, _SIM_ROUNDS),
+                pack_state(st))
+
+    eps.append(EntryPoint(
+        name="local[simulate,packed]", engine="xla", kind="simulate",
+        audit_check="simulate_and_coverage", build=build_sim_packed,
+        stats_leading=(_SIM_ROUNDS,), jit_name="simulate",
+        n_peers=ctx["dg"].n_pad,
+    ))
+
+    def build_cov_packed():
+        from tpu_gossip.core.packed import pack_state
+
+        st, cfg = ctx["state_for"](ctx["dg"], 16, mode="push_pull")
+        return (
+            lambda s: engine.run_until_coverage(s, cfg, 0.99, 10),
+            pack_state(st),
+        )
+
+    eps.append(EntryPoint(
+        name="local[run_until_coverage,packed]", engine="xla",
+        kind="coverage", audit_check="simulate_and_coverage",
+        build=build_cov_packed, stats_leading=None,
+        jit_name="run_until_coverage", n_peers=ctx["dg"].n_pad,
+    ))
+
     # the BATCHED fleet entry (fleet/): a composed scenario×stream×
     # control campaign vmapped over _FLEET_LANES lanes — the batched
     # round must stay a state fixed point AT BATCH RANK (the stacked
@@ -794,6 +829,29 @@ def _dist_entries() -> list[EntryPoint]:
         "dist[matching,simulate]", "dist-matching", "gossip_round_dist",
         {}, {}, kind="simulate", stats_leading=(_DIST_SIM_ROUNDS,),
         jit_name="simulate_dist",
+    ))
+
+    # the PACKED dist loop entry: the sharded scan carry is the packed
+    # storage ledger — fixed point + donation + mem pricing at the
+    # packed rank on the mesh (the 100M residency shape)
+    def build_dist_sim_packed():
+        from tpu_gossip.core.packed import pack_state
+
+        st, cfg = dctx["m_state"]()
+        from tpu_gossip.dist import mesh as mm
+
+        return (
+            lambda s: mm.simulate_dist(
+                s, cfg, plan, mesh, _DIST_SIM_ROUNDS
+            ),
+            pack_state(st),
+        )
+
+    eps.append(EntryPoint(
+        name="dist[matching,simulate,packed]", engine="dist-matching",
+        kind="simulate", audit_check="gossip_round_dist",
+        build=build_dist_sim_packed, stats_leading=(_DIST_SIM_ROUNDS,),
+        jit_name="simulate_dist", n_peers=plan.n,
     ))
     eps.append(dist_ep(
         "dist[bucketed,run_until_coverage]", "dist-bucketed",

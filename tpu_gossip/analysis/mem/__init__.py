@@ -99,8 +99,15 @@ def run_mem(
         "stale_budget_entries": stale,
         "budget_path": str(budget_path),
         # the ROADMAP metric at headline scale, from declared widths
-        # alone (no arrays built): state-plane bytes per peer slot
+        # alone (no arrays built): state-plane bytes per peer slot.
+        # Since the packed-plane PR the headline figure prices the PACKED
+        # storage ledger (what a PackedSwarm carry keeps resident and
+        # what checkpoints write); the unpacked compute materialization
+        # rides alongside for the round-transient view.
         "state_bytes_per_peer_1m": round(
+            state_bytes_per_peer(1_000_000, 16, packed=True), 3
+        ),
+        "state_bytes_per_peer_1m_unpacked": round(
             state_bytes_per_peer(1_000_000, 16), 3
         ),
     }

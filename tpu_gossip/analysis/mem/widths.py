@@ -67,10 +67,13 @@ def plane_width_findings(traced) -> list:
             qualname=f"SwarmState.{name}",
         ))
 
+    from tpu_gossip.core.packed import PackedSwarm
+
     seen: set = set()
     for te in traced.values():
         if te.state is None:
             continue
+        packed_state = isinstance(te.state, PackedSwarm)
         for f in dataclasses.fields(type(te.state)):
             spec = reg.get(f.name)
             if spec is None or spec.dtype == "key" or f.name in seen:
@@ -81,7 +84,15 @@ def plane_width_findings(traced) -> list:
             got = np.dtype(leaf.dtype) if leaf.dtype.kind != "V" else None
             if got is None:
                 continue
-            want = np.dtype(spec.dtype)
+            # a PackedSwarm entry materializes the registry's declared
+            # STORAGE encoding: "bits" planes are uint8 words (the flag
+            # planes have no field there — they live in the shared flags
+            # word, which carries no PlaneSpec and is skipped above)
+            want = (
+                np.dtype("uint8")
+                if packed_state and spec.packed == "bits"
+                else np.dtype(spec.dtype)
+            )
             if got != want:
                 seen.add(f.name)
                 direction = "WIDER" if got.itemsize > want.itemsize else \

@@ -64,7 +64,13 @@ __all__ = [
 ]
 
 MANIFEST_NAME = "MANIFEST.json"
-FORMAT_VERSION = 2
+# format 3 = PACKED storage (core/packed.py): the five (N, M) bool planes
+# land as LSB-first uint8 words, the six (N,) bool masks as one shared
+# uint8 ``flags`` word — a checkpoint byte is never wider than the PLANES
+# registry's packed declaration. Format 2 (unpacked planes) stays fully
+# readable; loading one decodes into the same state losslessly.
+FORMAT_VERSION = 3
+READABLE_FORMATS = (2, 3)
 _CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
 
 # planes stored per shard file are exactly the registry's (N, ·)-leading
@@ -82,13 +88,20 @@ def checkpoint_name(step: int) -> str:
     return f"ckpt-{step:08d}"
 
 
-def _row_planes():
+def _row_planes(packed: bool = False):
     from tpu_gossip.core.state import PLANES
 
-    return tuple(
+    base = tuple(
         p.name for p in PLANES
         if p.shape.startswith("(N") and p.name not in _CSR_PLANES
     )
+    if not packed:
+        return base
+    # packed storage: the six flag planes collapse into the shared (N,)
+    # uint8 word; the bit planes keep their names (packed arrays)
+    from tpu_gossip.core.packed import FLAG_PLANES
+
+    return tuple(p for p in base if p not in FLAG_PLANES) + ("flags",)
 
 
 def _global_planes():
@@ -141,12 +154,45 @@ def _state_to_host(state) -> dict:
     """Every leaf as a host array (PRNG keys via their raw key data)."""
     out = {}
     for f in dataclasses.fields(type(state)):
+        if f.name == "msg_slots":  # PackedSwarm's static width field
+            continue
         leaf = getattr(state, f.name)
         if _is_key(leaf):
             out[f.name] = _key_data(leaf)
         else:
             out[f.name] = np.asarray(leaf)
     return out
+
+
+def _pack_host(host: dict) -> dict:
+    """Format-3 encode: the ONE shared host codec (core/packed.py —
+    bit-for-bit the same words a PackedSwarm carry holds; save_swarm's
+    legacy npz writes through the same helper, so the formats cannot
+    drift)."""
+    from tpu_gossip.core.packed import pack_host_planes
+
+    return pack_host_planes(host)
+
+
+def _unpack_host(arrays: dict, m: int) -> dict:
+    """Format-3 decode through the shared host codec (lossless; forged
+    dtypes stay undecoded for the named-plane validator)."""
+    from tpu_gossip.core.packed import decode_host_planes
+
+    return decode_host_planes(arrays, m)
+
+
+def _host_packed(state) -> tuple[dict, int]:
+    """(packed host dict, msg_slots) for either state representation —
+    a PackedSwarm's leaves ARE the storage layout already; a SwarmState
+    packs through the numpy twins."""
+    from tpu_gossip.core.packed import PackedSwarm
+
+    if isinstance(state, PackedSwarm):
+        return _state_to_host(state), int(state.msg_slots)
+    host = _state_to_host(state)
+    m = int(host["seen"].shape[-1])
+    return _pack_host(host), m
 
 
 def _is_key(leaf) -> bool:
@@ -206,37 +252,37 @@ def save_checkpoint(
         lanes = int(lead[0])
         manifest["lanes"] = lanes
         manifest["n_peers"] = int(state.seen.shape[1])
-        manifest["msg_slots"] = int(state.seen.shape[2])
-        planes = {}
-        for f in dataclasses.fields(type(state)):
-            leaf = getattr(state, f.name)
-            if _is_key(leaf):
-                planes[f.name] = {"dtype": "key", "shape": []}
-            else:
-                # per-LANE dtype/shape: the lane axis is a storage
-                # dimension, each file holds one solo state
-                planes[f.name] = {
-                    "dtype": str(leaf.dtype),
-                    "shape": list(leaf.shape[1:]),
-                }
-        manifest["planes"] = planes
+        m = int(state.seen.shape[2])
+        manifest["msg_slots"] = m
+        lane_hosts = []
         for k in range(lanes):
-            lane_arrays = {}
+            lane_host = {}
             for f in dataclasses.fields(type(state)):
                 leaf = getattr(state, f.name)
                 if _is_key(leaf):
-                    lane_arrays[f"prngkey_{f.name}"] = _key_data(leaf[k])
+                    lane_host[f.name] = _key_data(leaf[k])
                 else:
-                    lane_arrays[f"field_{f.name}"] = np.asarray(leaf[k])
+                    lane_host[f.name] = np.asarray(leaf[k])
+            lane_hosts.append(_pack_host(lane_host))
+        manifest["planes"] = {
+            name: {"dtype": str(arr.dtype) if name != "rng" else "key",
+                   "shape": [] if name == "rng" else list(arr.shape)}
+            for name, arr in lane_hosts[0].items()
+        }
+        for k, lane_host in enumerate(lane_hosts):
+            lane_arrays = {
+                (f"prngkey_{p}" if p == "rng" else f"field_{p}"): arr
+                for p, arr in lane_host.items()
+            }
             name = f"lane-{k:05d}-of-{lanes:05d}.npz"
             entry = _atomic_write(ckdir / name, _npz_bytes(lane_arrays))
             entry["lane"] = k
             files[name] = entry
     elif kind == "run":
-        host = _state_to_host(state)
-        n = host["alive"].shape[0]
-        manifest["n_peers"] = n
-        manifest["msg_slots"] = int(host["seen"].shape[1])
+        host, m = _host_packed(state)
+        n = host["flags"].shape[0]
+        manifest["n_peers"] = int(n)
+        manifest["msg_slots"] = m
         manifest["shards"] = int(shards)
         manifest["planes"] = {
             name: {"dtype": str(arr.dtype) if name != "rng" else "key",
@@ -246,7 +292,7 @@ def save_checkpoint(
         rp = host["row_ptr"]
         e_real = int(rp[-1])
         bounds = np.linspace(0, n, int(shards) + 1).astype(int)
-        row_planes = [p for p in _row_planes() if p in host]
+        row_planes = [p for p in _row_planes(packed=True) if p in host]
         for s in range(int(shards)):
             lo, hi = int(bounds[s]), int(bounds[s + 1])
             arrays = {f"rows_{p}": host[p][lo:hi] for p in row_planes}
@@ -323,10 +369,10 @@ def verify_checkpoint(path) -> dict:
         raise CheckpointError(
             f"{path.name}: unreadable manifest ({e}) — torn write"
         ) from e
-    if manifest.get("format") != FORMAT_VERSION:
+    if manifest.get("format") not in READABLE_FORMATS:
         raise CheckpointError(
             f"{path.name}: manifest format {manifest.get('format')!r} "
-            f"(this build reads {FORMAT_VERSION})"
+            f"(this build reads {READABLE_FORMATS})"
         )
     for name, entry in manifest.get("files", {}).items():
         fpath = path / name
@@ -403,10 +449,16 @@ def load_checkpoint(path, *, lane: int | None = None,
     if manifest is None:
         manifest = verify_checkpoint(path)
     kind = manifest.get("kind", "run")
+    packed_fmt = manifest.get("format", 2) >= 3
 
     def build_solo(arrays: dict, source: str) -> SwarmState:
         from tpu_gossip.core.state import zero_suspicion
 
+        if "field_flags" in arrays:
+            # packed payload (format 3): decode the flags word + the bit
+            # planes back into the unpacked plane set — lossless, the
+            # exact inverse of the save-side codec
+            arrays = _unpack_host(arrays, int(manifest["msg_slots"]))
         kwargs = {}
         suspicion = ("suspect_round", "suspect_mark", "quarantine")
         for f in dataclasses.fields(SwarmState):
@@ -490,7 +542,7 @@ def load_checkpoint(path, *, lane: int | None = None,
                 f"declares n_peers={manifest['n_peers']}"
             )
         arrays = {}
-        for p in _row_planes():
+        for p in _row_planes(packed=packed_fmt):
             arrays[f"field_{p}"] = np.concatenate(
                 [part[f"rows_{p}"] for part in parts], axis=0
             )

@@ -303,6 +303,29 @@ def build_parser() -> argparse.ArgumentParser:
         "under --shard, else 1",
     )
     p.add_argument(
+        "--packed", action="store_true",
+        help="carry the swarm as PACKED state planes (core/packed.py, "
+        "docs/memory_budget.md): the scan/while carry — what stays "
+        "resident between rounds, and what checkpoints write — is the "
+        "registry's packed storage ledger (67 B/peer at m=16 vs 142 "
+        "unpacked); each round runs unpack -> the identical round "
+        "program -> repack, so the trajectory is BIT-IDENTICAL to the "
+        "unpacked run (test-pinned across the composed matrix). Works "
+        "on every engine path except --profile-round and the remat "
+        "epoch loops (which fold the unpacked CSR between segments)",
+    )
+    p.add_argument(
+        "--builder", choices=["local", "dist"], default="local",
+        help="matching-graph construction route (--shard --graph "
+        "matching only): 'local' builds the sharded layout globally on "
+        "one device then places it; 'dist' builds it BORN on the mesh "
+        "(dist/builder.py) — per-shard table derivation inside "
+        "shard_map, per-shard peak build memory, conformance-tested "
+        "bit-identical to the local block-keyed layout truth. The two "
+        "routes realize different (both valid) graphs: 'dist' uses the "
+        "per-shard-keyed derivation",
+    )
+    p.add_argument(
         "--digest", action="store_true",
         help="add state_digest/stats_digest (sha256 over the final state "
         "and the integer stat trajectory) to a fixed-horizon summary — "
@@ -415,6 +438,28 @@ def _run(args, resume=None) -> int:
         print("--profile-round decomposes the LOCAL round (use "
               "experiments/dist_profile.py for the mesh engines)",
               file=sys.stderr)
+        return 2
+    if args.packed and args.profile_round > 0:
+        print("--profile-round decomposes the UNPACKED round's stages; "
+              "the packed carry adds only the boundary codec — drop "
+              "--packed for the decomposition", file=sys.stderr)
+        return 2
+    if args.packed and args.remat_every > 0:
+        print("--packed cannot compose with --remat-every: the epoch "
+              "fold (rematerialize_rewired / re-partition) rebuilds the "
+              "unpacked CSR between segments; run the remat loop "
+              "unpacked", file=sys.stderr)
+        return 2
+    if args.builder == "dist" and not (args.shard
+                                       and args.graph == "matching"):
+        print("--builder dist builds the matching layout born on the "
+              "mesh (dist/builder.py); it needs --shard --graph matching",
+              file=sys.stderr)
+        return 2
+    if args.builder == "dist" and args.remat_every > 0:
+        print("--builder dist cannot compose with --remat-every: the "
+              "remat path falls back to the bucketed-CSR engine, which "
+              "rebuilds from a host partition", file=sys.stderr)
         return 2
     if args.pipeline is not None and not args.shard:
         print("--pipeline overlaps the SHARDED exchange with the "
@@ -552,6 +597,8 @@ def _run(args, resume=None) -> int:
     ctl = _compile_cli_control(args)
     lqs = _compile_cli_liveness(args)
     policy = _ckpt_policy(args, shards=1)
+    from tpu_gossip.core.packed import pack_state, unpack_state
+
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_with_remat(args, cfg, state, scen, grow,
@@ -560,13 +607,18 @@ def _run(args, resume=None) -> int:
             summary.update(_scenario_summary(spec))
         elif args.rounds > 0:
             if policy is None and resume is None:
-                fin, stats = simulate(state, cfg, args.rounds, plan,
+                st_in = pack_state(state) if args.packed else state
+                fin, stats = simulate(st_in, cfg, args.rounds, plan,
                                       args.tail, scen, grow, strm, ctl,
                                       None, lqs)
             else:
                 from tpu_gossip.ckpt import host_stats, run_checkpointed
 
                 state, prefix = _swap_in_resume(resume, state, args)
+                if args.packed:
+                    # the segmented carry — and therefore every periodic
+                    # checkpoint — is the packed storage ledger
+                    state = pack_state(state)
 
                 def seg_run(st, seg):
                     st, s = simulate(st, cfg, seg, plan, args.tail, scen,
@@ -578,6 +630,8 @@ def _run(args, resume=None) -> int:
                     stats_prefix=prefix, log=_stderr_log,
                 )
                 stats, _ici = _split_host_stats(sd)
+            if args.packed:
+                fin = unpack_state(fin)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(args, stats,
@@ -587,21 +641,26 @@ def _run(args, resume=None) -> int:
                                        **_liveness_summary(args, stats))
             summary.update(_digest_summary(args, fin, stats, policy, resume))
         else:
-            if scen is None and grow is None and ctl is None and lqs is None:
+            if args.packed or not (scen is None and grow is None
+                                   and ctl is None and lqs is None):
+                from tpu_gossip.sim.engine import run_until_coverage
+
+                def cov_run(st):
+                    st_in = pack_state(st) if args.packed else st
+                    out = run_until_coverage(
+                        st_in, cfg, args.target, args.max_rounds, plan=plan,
+                        tail=args.tail, scenario=scen, growth=grow,
+                        control=ctl, liveness=lqs,
+                    )
+                    return unpack_state(out) if args.packed else out
+
+                result, fin = M.bench_swarm(
+                    state, cfg, args.target, args.max_rounds, run=cov_run,
+                )
+            else:
                 result, fin = M.bench_swarm(
                     state, cfg, args.target, args.max_rounds, plan=plan,
                     tail=args.tail,
-                )
-            else:
-                from tpu_gossip.sim.engine import run_until_coverage
-
-                result, fin = M.bench_swarm(
-                    state, cfg, args.target, args.max_rounds,
-                    run=lambda st: run_until_coverage(
-                        st, cfg, args.target, args.max_rounds, plan=plan,
-                        tail=args.tail, scenario=scen, growth=grow,
-                        control=ctl, liveness=lqs,
-                    ),
                 )
             summary = {"summary": True, "mode": args.mode,
                        **_scenario_summary(spec),
@@ -609,6 +668,7 @@ def _run(args, resume=None) -> int:
                        **_liveness_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
+    summary.update(_layout_summary(args))
     print(json.dumps(summary))
 
     if args.checkpoint:
@@ -1356,6 +1416,16 @@ def _manifest_run_config(args) -> dict:
         if not k.startswith("_")
         and (v is None or isinstance(v, (str, int, float, bool)))
     }
+
+
+def _layout_summary(args) -> dict:
+    """Summary-row layout fields: whether the run carried packed state
+    planes (core/packed.py) and which matching builder laid the graph
+    out (only meaningful on --shard --graph matching paths)."""
+    out = {"packed": bool(getattr(args, "packed", False))}
+    if getattr(args, "builder", "local") != "local":
+        out["builder"] = args.builder
+    return out
 
 
 def _stderr_log(msg: str) -> None:
@@ -2173,15 +2243,33 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
             )
         _check_resume_devices(resume, mesh.size)
         n_build = mesh.size
-    dgraph, plan = matching_powerlaw_graph_sharded(
-        args.peers, n_build, gamma=args.gamma,
-        fanout=None if args.mode == "flood" else args.fanout,
-        key=jax.random.key(args.seed),
-        growth_rows=(
-            -(-(args.grow_capacity - args.peers) // n_build)
-            if args.grow else 0
-        ),
+    grow_rows = (
+        -(-(args.grow_capacity - args.peers) // n_build)
+        if args.grow else 0
     )
+    if getattr(args, "builder", "local") == "dist" and not local:
+        # born-distributed construction: per-shard blocks derived inside
+        # shard_map, per-shard peak build memory, arrays already placed
+        # (dist/builder.py; bit-identical to the block-keyed local build)
+        from tpu_gossip.dist import matching_powerlaw_graph_dist
+
+        dgraph, plan = matching_powerlaw_graph_dist(
+            args.peers, mesh, gamma=args.gamma,
+            fanout=None if args.mode == "flood" else args.fanout,
+            key=jax.random.key(args.seed),
+            growth_rows=grow_rows,
+        )
+    else:
+        dgraph, plan = matching_powerlaw_graph_sharded(
+            args.peers, n_build, gamma=args.gamma,
+            fanout=None if args.mode == "flood" else args.fanout,
+            key=jax.random.key(args.seed),
+            growth_rows=grow_rows,
+            # a local restore of a --builder dist run rebuilds the SAME
+            # layout through the block-keyed derivation (the conformance
+            # contract: the two builds are bit-identical)
+            block_keys=getattr(args, "builder", "local") == "dist",
+        )
     if not local:
         plan = shard_matching_plan(plan, mesh)
     from tpu_gossip.dist import build_transport
@@ -2230,21 +2318,26 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
     lqs = _compile_cli_liveness(args)
     pipe = _compile_cli_pipeline(args)
     policy = _ckpt_policy(args, shards=n_build, extra={"devices": n_build})
+    from tpu_gossip.core.packed import pack_state, unpack_state
+
     with trace(args.profile):
         if args.rounds > 0:
             if policy is None and resume is None:
+                st_in = pack_state(state) if args.packed else state
                 if transport is not None:
                     fin, (stats, ici) = simulate_dist(
-                        state, cfg, plan, mesh, args.rounds, None, scen,
+                        st_in, cfg, plan, mesh, args.rounds, None, scen,
                         grow, transport, True, strm, ctl, pipe, lqs,
                     )
                 else:
-                    fin, stats = simulate_dist(state, cfg, plan, mesh,
+                    fin, stats = simulate_dist(st_in, cfg, plan, mesh,
                                                args.rounds, None, scen,
                                                grow, stream=strm,
                                                control=ctl, pipeline=pipe,
                                                liveness=lqs)
                     ici = None
+                if args.packed:
+                    fin = unpack_state(fin)
             else:
                 from tpu_gossip.ckpt import host_stats, run_checkpointed
                 from tpu_gossip.sim.engine import simulate
@@ -2259,6 +2352,11 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                     # stats are unaffected — the transport never draws)
                     prefix = {k: v for k, v in prefix.items()
                               if not k.startswith("ici__")}
+
+                if args.packed:
+                    # the segmented carry — and every periodic
+                    # checkpoint — is the packed storage ledger
+                    state = pack_state(state)
 
                 def seg_run(st, seg):
                     if local:
@@ -2281,6 +2379,8 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                     state, args.rounds, seg_run, policy=policy,
                     stats_prefix=prefix, log=_stderr_log,
                 )
+                if args.packed:
+                    fin = unpack_state(fin)
                 stats, ici = _split_host_stats(sd)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -2301,11 +2401,13 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
             # at the realized horizon (the bench.py pattern), summed in
             # int64 host-side
             def cov_run(st):
-                return run_until_coverage_dist(
-                    st, cfg, plan, mesh, args.target, args.max_rounds,
+                out = run_until_coverage_dist(
+                    pack_state(st) if args.packed else st,
+                    cfg, plan, mesh, args.target, args.max_rounds,
                     scenario=scen, growth=grow, transport=transport,
                     control=ctl, pipeline=pipe, liveness=lqs,
                 )
+                return unpack_state(out) if args.packed else out
 
             r0 = int(state.round)
             result, fin = M.bench_swarm(
@@ -2331,6 +2433,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                        **_liveness_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
+    summary.update(_layout_summary(args))
     print(json.dumps(summary))
 
     if args.checkpoint:
@@ -2408,6 +2511,8 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     policy = _ckpt_policy(args, shards=mesh.size,
                           extra={"devices": mesh.size})
     _check_resume_devices(resume, mesh.size)
+    from tpu_gossip.core.packed import pack_state, unpack_state
+
     with trace(args.profile):
         if args.remat_every > 0:
             summary, fin = _run_shard_with_remat(
@@ -2420,18 +2525,21 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
             summary.update(_control_summary(args))
         elif args.rounds > 0:
             if policy is None and resume is None:
+                st_in = pack_state(state) if args.packed else state
                 if transport is not None:
                     fin, (stats, ici) = simulate_dist(
-                        state, cfg, sg, mesh, args.rounds, plans, scen, grow,
+                        st_in, cfg, sg, mesh, args.rounds, plans, scen, grow,
                         transport, True, strm, ctl, pipe, lqs,
                     )
                 else:
-                    fin, stats = simulate_dist(state, cfg, sg, mesh,
+                    fin, stats = simulate_dist(st_in, cfg, sg, mesh,
                                                args.rounds, plans, scen,
                                                grow, stream=strm,
                                                control=ctl, pipeline=pipe,
                                                liveness=lqs)
                     ici = None
+                if args.packed:
+                    fin = unpack_state(fin)
             else:
                 from tpu_gossip.ckpt import host_stats, run_checkpointed
                 from tpu_gossip.dist import shard_swarm as _reshard
@@ -2439,6 +2547,8 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                 loaded, prefix = _swap_in_resume(resume, state, args)
                 state = _reshard(loaded, mesh) if resume is not None \
                     else state
+                if args.packed:
+                    state = pack_state(state)
 
                 def seg_run(st, seg):
                     if transport is not None:
@@ -2457,6 +2567,8 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                     state, args.rounds, seg_run, policy=policy,
                     stats_prefix=prefix, log=_stderr_log,
                 )
+                if args.packed:
+                    fin = unpack_state(fin)
                 stats, ici = _split_host_stats(sd)
             if not args.quiet:
                 M.write_jsonl(stats, sys.stdout)
@@ -2478,12 +2590,14 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
             # trajectory comes from an untimed bit-identical replay at
             # the realized horizon, summed in int64 host-side
             def cov_run(st):
-                return run_until_coverage_dist(
-                    st, cfg, sg, mesh, args.target, args.max_rounds,
+                out = run_until_coverage_dist(
+                    pack_state(st) if args.packed else st,
+                    cfg, sg, mesh, args.target, args.max_rounds,
                     shard_plan=plans, scenario=scen, growth=grow,
                     transport=transport, control=ctl, pipeline=pipe,
                     liveness=lqs,
                 )
+                return unpack_state(out) if args.packed else out
 
             r0 = int(state.round)
             result, fin = M.bench_swarm(
@@ -2508,6 +2622,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                        **_liveness_summary(args),
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
+    summary.update(_layout_summary(args))
     print(json.dumps(summary))
 
     if args.checkpoint:

@@ -77,7 +77,135 @@ __all__ = [
     "pipeline_stages",
     "expand_classes",
     "reduce_classes",
+    "DEG_TABLE_CAP",
+    "deg_table_dtype",
+    "sharded_layout",
+    "plan_table_widths",
 ]
+
+# declared value cap of the NARROW degree tables (deg_other/deg_real):
+# when the build's d_max fits, the tables store int16 with saturation at
+# this cap (jnp.minimum at the one write site) — the matching family's
+# twin of core.state.ROUND_CAP. Every consumer reads the tables through
+# float32 threshold math or `> 0` masks, so the narrow width is
+# value-identical wherever the cap permits it (registry-declared in
+# plan_table_widths; the --planes CLI prices it).
+DEG_TABLE_CAP = 2**15 - 1
+
+
+def deg_table_dtype(d_max: int):
+    """The declared degree-table dtype for a build capped at ``d_max``."""
+    return jnp.int16 if d_max <= DEG_TABLE_CAP else jnp.int32
+
+
+def sharded_layout(
+    n: int,
+    n_shards: int,
+    gamma: float = 2.5,
+    d_min: int = 2,
+    d_max: int | None = None,
+    growth_rows: int = 0,
+) -> dict:
+    """THE host planning of the sharded matching layout — one law, three
+    consumers: ``matching_powerlaw_graph_sharded`` (the local build),
+    ``dist.builder.matching_powerlaw_graph_dist`` (the born-distributed
+    twin, whose bit-identity conformance rests on planning the SAME
+    layout), and :func:`plan_table_widths` (the CI-priced table ledger —
+    sharing the law means the ledger cannot silently misprice a future
+    planning change the builders pick up). Pure host arithmetic."""
+    if d_max is None:
+        d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
+    n_per = -(-n // n_shards)
+    deg_local = quantile_degrees(n_per, gamma, d_min, d_max)
+    local_classes = _plan_classes(deg_local)
+    last = local_classes[-1]
+    n_slots_local = last[1] + last[3] * last[4]
+    # per-shard row granularity: int8 stage tables need each shard's
+    # block to hold whole (32, 128) tiles, so the narrow-table choice
+    # keys on per_rows, not the global row count
+    gran = 32 if n_slots_local * n_shards >= (1 << 19) else 8
+    per_rows = math.ceil(n_slots_local / (128 * gran)) * gran
+    rows = per_rows * n_shards
+    n_blk = n_per + growth_rows + 1
+    return {
+        "d_max": d_max,
+        "n_per": n_per,
+        "deg_local": deg_local,
+        "local_classes": local_classes,
+        "per_rows": per_rows,
+        "rows": rows,
+        "n_blk": n_blk,
+        "n_state": n_shards * n_blk,
+        "n_stages": max(
+            2, math.ceil(math.log(max(rows, 2)) / math.log(128))
+        ),
+        "int8_tables": per_rows % 32 == 0,
+    }
+
+
+def plan_table_widths(
+    n: int,
+    gamma: float = 2.5,
+    d_min: int = 2,
+    d_max: int | None = None,
+    n_shards: int = 1,
+) -> dict:
+    """Declared MatchingPlan table widths + bytes at a given scale —
+    host arithmetic only (degree quantiles + class planning, no arrays
+    built), so the table ledger is quotable at 100M like the state
+    registry's. Returns ``name -> {dtype, shape, bytes, why}``.
+    """
+    if n_shards > 1:
+        lay = sharded_layout(n, n_shards, gamma, d_min, d_max)
+        d_max, rows = lay["d_max"], lay["rows"]
+        int8_ok, n_state = lay["int8_tables"], lay["n_state"]
+        k = lay["n_stages"]
+    else:
+        if d_max is None:
+            d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
+        deg = quantile_degrees(n, gamma, d_min, d_max)
+        lc = _plan_classes(deg)
+        n_slots = lc[-1][1] + lc[-1][3] * lc[-1][4]
+        gran = 32 if n_slots >= (1 << 19) else 8
+        rows = math.ceil(n_slots / (128 * gran)) * gran
+        int8_ok = rows % 32 == 0
+        n_state = n + 1
+        k = max(2, math.ceil(math.log(max(rows, 2)) / math.log(128)))
+    lane_dt = "int8" if int8_ok else "int32"
+    lane_b = 1 if int8_ok else 4
+    deg_dt = "int16" if d_max <= DEG_TABLE_CAP else "int32"
+    deg_b = 2 if d_max <= DEG_TABLE_CAP else 4
+    slots = rows * 128
+    return {
+        "lanes": {
+            "dtype": lane_dt, "shape": f"({k}, {rows}, 128)",
+            "bytes": k * slots * lane_b,
+            "why": "lane ids < 128 — int8 when the (32, 128) tile "
+            "granularity holds",
+        },
+        "lanes_inv": {
+            "dtype": lane_dt, "shape": f"({k}, {rows}, 128)",
+            "bytes": k * slots * lane_b, "why": "inverse tables, same law",
+        },
+        "m3": {
+            "dtype": lane_dt, "shape": f"({rows}, 128)",
+            "bytes": slots * lane_b, "why": "pairing involution, lane ids",
+        },
+        "valid": {
+            "dtype": "bool", "shape": f"({rows}, 128)", "bytes": slots,
+            "why": "erasure-survivor bit",
+        },
+        "deg_other": {
+            "dtype": deg_dt, "shape": f"({rows}, 128)",
+            "bytes": slots * deg_b,
+            "why": f"partner degrees <= d_max={d_max}; int16 saturating "
+            f"at DEG_TABLE_CAP={DEG_TABLE_CAP} when the cap permits",
+        },
+        "deg_real": {
+            "dtype": deg_dt, "shape": f"({n_state},)",
+            "bytes": n_state * deg_b, "why": "realized degrees, same cap",
+        },
+    }
 
 # classes at or above this node count store slots position-major with
 # 1024-aligned plane strides (Pallas fold); smaller classes store
@@ -144,7 +272,7 @@ class MatchingPlan:
         return jnp.where(
             self.valid & (self.deg_other > 0),
             bernoulli_threshold_device(
-                f / jnp.maximum(self.deg_other, 1).astype(jnp.float32)
+                f / jnp.maximum(self.deg_other, 1).astype(jnp.float32)  # graftlint: disable=mem-widening-cast -- the int16 degree table widens transiently into the f32 Bernoulli law; values <= DEG_TABLE_CAP are f32-exact, so gates are bit-identical to the int32 table's
             ),
             jnp.uint32(0),
         )
@@ -155,7 +283,7 @@ class MatchingPlan:
         return jnp.where(
             self.valid & (deg_self > 0),
             bernoulli_threshold_device(
-                1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)
+                1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)  # graftlint: disable=mem-widening-cast -- int16 degree table widening transiently into the f32 Bernoulli law; exact under DEG_TABLE_CAP, gates bit-identical
             ),
             jnp.uint32(0),
         )
@@ -317,10 +445,16 @@ def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
     classes = []
     i = 0
     slot_off = 0
+    deg = np.asarray(deg)
+    # the needle must be the array's OWN dtype: a Python-int needle makes
+    # numpy upcast the whole 12.5M-element array per searchsorted call —
+    # O(n) instead of O(log n), measured as 10.5 s of host planning at
+    # the 100M scale (values are degree-bounded, so the cast is exact)
+    ndt = deg.dtype.type
     while i < n:
         d0 = max(1, int(deg[i]))
         limit = max(d0, int(d0 * pad_ratio))
-        j = int(np.searchsorted(deg, limit, side="right"))
+        j = int(np.searchsorted(deg, ndt(limit), side="right"))
         j = max(j, i + 1)
         pad_deg = max(1, int(deg[j - 1]))
         count = j - i
@@ -348,7 +482,7 @@ def _plan_classes(deg: np.ndarray, pad_ratio: float = 1.06) -> tuple:
     jax.jit,
     static_argnames=(
         "n", "rows", "classes", "interpret", "export_csr", "sentinel",
-        "int8_tables",
+        "int8_tables", "deg_cap", "block_keys", "n_shards", "n_blk",
     ),
 )
 def _build_plan(
@@ -362,6 +496,10 @@ def _build_plan(
     export_csr: bool = True,
     sentinel: int | None = None,
     int8_tables: bool | None = None,
+    deg_cap: int | None = None,
+    block_keys: bool = False,
+    n_shards: int = 1,
+    n_blk: int = 0,
 ):
     """``sentinel``: CSR row absorbing erased edges. None (classic) appends
     an extra row ``n`` (the DeviceGraph padding peer); the sharded layout
@@ -369,7 +507,21 @@ def _build_plan(
     multiple of the mesh), so the CSR has exactly ``n`` rows. ``int8_tables``
     overrides the narrow-table choice — the sharded build keys it on the
     PER-SHARD row count (lane_shuffle's (32, 128) int8 tile granularity
-    must hold for each shard's block, not just the global array)."""
+    must hold for each shard's block, not just the global array).
+    ``deg_cap``: when set at or under :data:`DEG_TABLE_CAP`, the degree
+    tables store int16, saturating at the cap (value-identical whenever
+    d_max fits — the registry-declared narrow width).
+
+    ``block_keys`` (with ``n_shards``/``n_blk``) selects the
+    DISTRIBUTABLE derivation: every random table draws per shard block
+    (``fold_in(stage_key, shard)`` at (per_rows, 128)), and erased edges
+    absorb into EACH SHARD'S OWN pad row instead of one global sentinel
+    — so shard s's whole plan block (tables, validity, CSR segment) is a
+    pure function of shard-local draws plus the pipeline's cross-shard
+    transposes. This is the layout truth the born-distributed builder
+    (dist/builder.py) reproduces bit-identically inside ``shard_map``;
+    the classic ``block_keys=False`` derivation is unchanged, so
+    existing graphs and their recorded trajectories stay bit-stable."""
     r = rows
     # mixing depth: 128^K must reach every row or the matching is banded
     # (see MatchingPlan.stages); K=2 suffices to ~2M slots, 10M needs 3
@@ -381,13 +533,23 @@ def _build_plan(
     if int8_tables is None:
         int8_tables = r % 32 == 0
     tdt = jnp.int8 if int8_tables else jnp.int32
+
+    def table_bits(k):
+        """One (r, 128) uniform table — drawn whole (classic) or as
+        per-shard fold_in blocks (the distributable derivation)."""
+        if not block_keys:
+            return jax.random.uniform(k, (r, 128))
+        per = r // n_shards
+        return jnp.concatenate([
+            jax.random.uniform(jax.random.fold_in(k, sh), (per, 128))
+            for sh in range(n_shards)
+        ], axis=0)
+
     lanes = tuple(
-        jnp.argsort(jax.random.uniform(keys[i], (r, 128)), axis=1).astype(tdt)
+        jnp.argsort(table_bits(keys[i]), axis=1).astype(tdt)
         for i in range(n_stages)
     )
-    p = jnp.argsort(
-        jax.random.uniform(keys[n_stages], (r, 128)), axis=1
-    ).astype(jnp.int32)
+    p = jnp.argsort(table_bits(keys[n_stages]), axis=1).astype(jnp.int32)
     a, b = p[:, 0::2], p[:, 1::2]
     rows_ix = jnp.arange(r, dtype=jnp.int32)[:, None]
     m3 = (
@@ -457,9 +619,21 @@ def _build_plan(
     valid = alive & ~dup_both
 
     # --- realized degrees + partner degrees (thresholds are computed
-    # elementwise per round from these — no resident threshold tables) ----
-    deg_real = plan0.reduce(valid.astype(jnp.int32), op="sum")
-    deg_other = plan0.partner(plan0.expand(deg_real), interpret=interpret)
+    # elementwise per round from these — no resident threshold tables).
+    # The declared-narrow width (DEG_TABLE_CAP) lands at the ONE write
+    # site, saturating — value-identical whenever the build's d_max fits
+    deg_i32 = plan0.reduce(valid.astype(jnp.int32), op="sum")
+    deg_dt = (
+        jnp.int16 if deg_cap is not None and deg_cap <= DEG_TABLE_CAP
+        else jnp.int32
+    )
+    deg_real = jnp.minimum(deg_i32, DEG_TABLE_CAP).astype(deg_dt) \
+        if deg_dt == jnp.int16 else deg_i32
+    deg_other = plan0.partner(
+        plan0.expand(deg_i32), interpret=interpret
+    )
+    if deg_dt == jnp.int16:
+        deg_other = jnp.minimum(deg_other, DEG_TABLE_CAP).astype(deg_dt)
 
     # --- CSR export (sentinel-row form, device_topology.py:152-161) ------
     # optional: the matching delivery, liveness, and SIR never read the
@@ -468,9 +642,21 @@ def _build_plan(
     # north-star accounting charges only what the config needs)
     sent_row = n if sentinel is None else sentinel
     n_rows = n + 1 if sentinel is None else n  # CSR rows incl. sentinel
+    if block_keys:
+        # per-shard sentinels: shard s's erased edges absorb into ITS pad
+        # row — every shard's CSR segment is then a pure function of its
+        # own slots, and the global stable sort below equals the
+        # concatenation of shard-local sorts (src ranges are disjoint and
+        # shard-ordered), which is what the born-distributed builder
+        # computes per shard
+        per_slots = (r // n_shards) * 128
+        shard_of = (sentinel_fill // per_slots).reshape(-1)
+        sent_row = shard_of * n_blk + (n_blk - 1)
     if export_csr:
-        src = jnp.where(valid, owner, sent_row).reshape(-1)
-        dst = jnp.where(valid, other_owner, sent_row).reshape(-1)
+        src = jnp.where(valid.reshape(-1), owner.reshape(-1), sent_row)
+        dst = jnp.where(
+            valid.reshape(-1), other_owner.reshape(-1), sent_row
+        )
         csr_order = jnp.argsort(src)
         col_idx = dst[csr_order]
         row_ptr = jnp.searchsorted(
@@ -538,7 +724,7 @@ def matching_powerlaw_graph(
         lanes, m3, lanes_inv, valid, deg_other, deg_real, row_ptr, col_idx,
     ) = _build_plan(
         key, deg, n=n, rows=rows, classes=classes, interpret=interpret,
-        export_csr=export_csr,
+        export_csr=export_csr, deg_cap=d_max,
     )
     plan = MatchingPlan(
         lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
@@ -564,6 +750,7 @@ def matching_powerlaw_graph_sharded(
     interpret: bool | None = None,
     export_csr: bool = True,
     growth_rows: int = 0,
+    block_keys: bool = False,
 ) -> tuple[DeviceGraph, MatchingPlan]:
     """Structured-matching power-law swarm laid out for an ``n_shards`` mesh.
 
@@ -620,6 +807,16 @@ def matching_powerlaw_graph_sharded(
     slot fraction and the realized graph noticeably sparser than the law
     (the classic build has the same artifact an order of magnitude lower).
     Real workloads (>= ~100k peers per shard) see sub-percent erasure.
+
+    ``block_keys=True`` selects the DISTRIBUTABLE derivation (see
+    ``_build_plan``): per-shard-keyed random tables and per-shard CSR
+    sentinels, so every plan/graph block is a function of shard-local
+    draws plus the pipeline's transposes — the layout truth the
+    born-distributed builder (``dist.builder.
+    matching_powerlaw_graph_dist``) reproduces bit-identically inside
+    ``shard_map`` with no global materialization. The default (False)
+    keeps the historical derivation and its recorded trajectories
+    bit-stable; both layouts run every engine unchanged.
     """
     if key is None:
         key = jax.random.key(0)
@@ -631,21 +828,10 @@ def matching_powerlaw_graph_sharded(
         )
     if growth_rows < 0:
         raise ValueError(f"growth_rows={growth_rows} must be >= 0")
-    if d_max is None:
-        d_max = max(d_min + 1, int(round(n ** (1.0 / (gamma - 1.0)))))
-    n_per = -(-n // s)
-    deg_local = quantile_degrees(n_per, gamma, d_min, d_max)
-    local_classes = _plan_classes(deg_local)
-    last = local_classes[-1]
-    n_slots_local = last[1] + last[3] * last[4]
-    # per-shard row granularity: int8 stage tables need each shard's block
-    # to hold whole (32, 128) tiles, so the narrow-table choice keys on
-    # per_rows, not the global row count
-    gran = 32 if n_slots_local * s >= (1 << 19) else 8
-    per_rows = math.ceil(n_slots_local / (128 * gran)) * gran
-    rows = per_rows * s
-    n_blk = n_per + growth_rows + 1
-    n_state = s * n_blk
+    lay = sharded_layout(n, s, gamma, d_min, d_max, growth_rows)
+    d_max, n_per, deg_local = lay["d_max"], lay["n_per"], lay["deg_local"]
+    local_classes, per_rows = lay["local_classes"], lay["per_rows"]
+    rows, n_blk, n_state = lay["rows"], lay["n_blk"], lay["n_state"]
     classes = tuple(
         (sh * n_blk + no, sh * per_rows * 128 + so, c, pd, cs)
         for sh in range(s)
@@ -659,7 +845,8 @@ def matching_powerlaw_graph_sharded(
     ) = _build_plan(
         key, jnp.asarray(deg_state), n=n_state, rows=rows, classes=classes,
         interpret=interpret, export_csr=export_csr,
-        sentinel=n_state - 1, int8_tables=(per_rows % 32 == 0),
+        sentinel=n_state - 1, int8_tables=lay["int8_tables"],
+        deg_cap=d_max, block_keys=block_keys, n_shards=s, n_blk=n_blk,
     )
     plan = MatchingPlan(
         lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,

@@ -96,6 +96,13 @@ class PlaneSpec:
     ``info_bits`` is the information content per element (the bit-packing
     headroom the 100M item tracks: a bool plane materializes 8 bits for
     1, SIR/liveness fit 2 bits jointly, …).
+    ``packed`` is the plane's declared STORAGE encoding (core/packed.py;
+    what checkpoints write and a :class:`~tpu_gossip.core.packed.
+    PackedSwarm` carry holds resident): ``"bits"`` packs the (N, M) bool
+    plane LSB-first into uint8 words along the slot axis; ``"flag:<k>"``
+    stores the (N,) bool plane as bit ``k`` of the shared (N,) uint8
+    ``flags`` word (the byte itself is priced once, on the ``flag:0``
+    holder); ``None`` stores the compute dtype verbatim.
     """
 
     name: str
@@ -103,6 +110,7 @@ class PlaneSpec:
     shape: str  # symbolic: "(N,)" | "(N, M)" | "(N+1,)" | "(D,)" | "(N, S)" | "(M,)" | "()"
     info_bits: int  # minimal information content per element
     why: str  # the cap that makes the width sufficient
+    packed: str | None = None  # declared storage encoding (core/packed.py)
 
 
 PLANES: tuple[PlaneSpec, ...] = (
@@ -110,24 +118,29 @@ PLANES: tuple[PlaneSpec, ...] = (
               "cumulative edge counts: D < 2^31 at every tracked scale"),
     PlaneSpec("col_idx", "int32", "(D,)", 32,
               "peer row ids: N up to 100M needs 27 bits"),
-    PlaneSpec("seen", "bool", "(N, M)", 1, "dedup bit"),
-    PlaneSpec("forwarded", "bool", "(N, M)", 1, "relay bit"),
+    PlaneSpec("seen", "bool", "(N, M)", 1, "dedup bit", packed="bits"),
+    PlaneSpec("forwarded", "bool", "(N, M)", 1, "relay bit", packed="bits"),
     PlaneSpec("infected_round", "int16", "(N, M)", 16,
               "round numbers: -1 or a first-receipt round <= ROUND_CAP "
               "(saturate_round at every latch site)"),
     PlaneSpec("recovered", "bool", "(N, M)", 1,
-              "SIR removed bit (with seen: the 2-bit SIR state)"),
-    PlaneSpec("exists", "bool", "(N,)", 1, "membership bit"),
-    PlaneSpec("alive", "bool", "(N,)", 1, "liveness bit"),
-    PlaneSpec("silent", "bool", "(N,)", 1, "fault bit"),
+              "SIR removed bit (with seen: the 2-bit SIR state)",
+              packed="bits"),
+    PlaneSpec("exists", "bool", "(N,)", 1, "membership bit",
+              packed="flag:0"),
+    PlaneSpec("alive", "bool", "(N,)", 1, "liveness bit", packed="flag:1"),
+    PlaneSpec("silent", "bool", "(N,)", 1, "fault bit", packed="flag:2"),
     PlaneSpec("last_hb", "int16", "(N,)", 16,
               "round numbers: a heartbeat round <= ROUND_CAP "
               "(saturate_round at every refresh site)"),
-    PlaneSpec("declared_dead", "bool", "(N,)", 1, "detector verdict bit"),
-    PlaneSpec("rewired", "bool", "(N,)", 1, "re-attach bit"),
+    PlaneSpec("declared_dead", "bool", "(N,)", 1, "detector verdict bit",
+              packed="flag:3"),
+    PlaneSpec("rewired", "bool", "(N,)", 1, "re-attach bit",
+              packed="flag:4"),
     PlaneSpec("rewire_targets", "int32", "(N, S)", 32,
               "peer row ids: need 27 bits at 100M"),
-    PlaneSpec("fault_held", "bool", "(N, M)", 1, "delay-buffer bit"),
+    PlaneSpec("fault_held", "bool", "(N, M)", 1, "delay-buffer bit",
+              packed="bits"),
     PlaneSpec("join_round", "int16", "(N,)", 16,
               "round numbers: -1 or a round index <= ROUND_CAP"),
     PlaneSpec("admitted_by", "int32", "(N,)", 32,
@@ -140,7 +153,8 @@ PLANES: tuple[PlaneSpec, ...] = (
     PlaneSpec("control_lvl", "int32", "()", 8,
               "level index into a tiny fanout table; scalar — narrowing "
               "saves nothing"),
-    PlaneSpec("pipe_buf", "bool", "(N, M)", 1, "in-flight delivery bit"),
+    PlaneSpec("pipe_buf", "bool", "(N, M)", 1, "in-flight delivery bit",
+              packed="bits"),
     PlaneSpec("suspect_round", "int16", "(N,)", 16,
               "round numbers: -1 or the suspicion-entry round <= ROUND_CAP "
               "(saturate_round at the latch site)"),
@@ -149,7 +163,8 @@ PLANES: tuple[PlaneSpec, ...] = (
               "saturating at SUSPECT_VOTE_CAP=255) + false-accusation "
               "strikes (high 7 bits, saturating at SUSPECT_STRIKE_CAP="
               "127) — max packed value 32767 fits int16 exactly"),
-    PlaneSpec("quarantine", "bool", "(N,)", 1, "Byzantine-verdict bit"),
+    PlaneSpec("quarantine", "bool", "(N,)", 1, "Byzantine-verdict bit",
+              packed="flag:5"),
     PlaneSpec("rng", "key", "()", 64, "threefry key (2x uint32)"),
     PlaneSpec("round", "int32", "()", 16, "scalar round cursor"),
 )
@@ -166,7 +181,7 @@ def _dtype_bytes(dtype: str) -> int:
 
 def state_plane_bytes(
     n: int, m: int, rewire_slots: int = 1, d: int | None = None,
-    lanes: int = 1,
+    lanes: int = 1, packed: bool = False,
 ) -> dict:
     """Declared bytes per plane at (N=n, M=m, S=rewire_slots, D=d).
 
@@ -177,27 +192,40 @@ def state_plane_bytes(
     (fleet/) stacks ``lanes`` independent swarms into one batched pytree,
     and every plane — scalars and the CSR included, since each lane's
     state owns its leaves — materializes ``lanes`` copies.
+
+    ``packed=True`` prices the declared STORAGE encoding instead of the
+    compute materialization (the ``PlaneSpec.packed`` column, realized by
+    core/packed.py and the checkpoint stores): ``"bits"`` planes cost
+    ceil(M/8) bytes per row, and the six ``"flag:*"`` planes cost the ONE
+    shared uint8 word — attributed in full to the ``flag:0`` holder
+    (``exists``) with the other five priced 0, so the dict still sums to
+    the true total.
     """
     d = 0 if d is None else d
     dims = {"N": n, "M": m, "S": max(rewire_slots, 1), "D": d}
     out = {}
     for p in PLANES:
         elems = max(lanes, 1)
-        for term in p.shape.strip("()").split(","):
-            term = term.strip()
-            if not term:
-                continue
-            if term == "N+1":
-                elems *= n + 1
-            else:
-                elems *= dims[term]
+        terms = [t.strip() for t in p.shape.strip("()").split(",") if t.strip()]
+        if packed and p.packed == "bits":
+            # last term is the slot axis M: ceil(M/8) uint8 words
+            for term in terms[:-1]:
+                elems *= n + 1 if term == "N+1" else dims[term]
+            out[p.name] = elems * ((dims[terms[-1]] + 7) // 8)
+            continue
+        if packed and p.packed is not None and p.packed.startswith("flag:"):
+            # one shared (N,) uint8 word for all six masks, charged once
+            out[p.name] = elems * n if p.packed == "flag:0" else 0
+            continue
+        for term in terms:
+            elems *= n + 1 if term == "N+1" else dims[term]
         out[p.name] = elems * _dtype_bytes(p.dtype)
     return out
 
 
 def state_bytes_per_peer(
     n: int, m: int, rewire_slots: int = 1, d: int | None = None,
-    lanes: int = 1,
+    lanes: int = 1, packed: bool = False,
 ) -> float:
     """The ROADMAP's tracked metric: declared state bytes per peer slot.
 
@@ -207,9 +235,12 @@ def state_bytes_per_peer(
     ``lanes * n`` — a batched campaign's bytes/peer equals the solo
     figure (stacking adds no per-peer overhead; only the per-lane
     scalars amortize differently, a rounding-level effect).
+    ``packed=True`` prices the packed storage ledger (see
+    :func:`state_plane_bytes`) — what a PackedSwarm carry holds resident
+    between rounds and what the checkpoint stores write.
     """
     return sum(
-        state_plane_bytes(n, m, rewire_slots, d, lanes).values()
+        state_plane_bytes(n, m, rewire_slots, d, lanes, packed).values()
     ) / (n * max(lanes, 1))
 
 
@@ -401,14 +432,27 @@ def save_swarm(path, state: SwarmState) -> None:
     sharding. The production route is ``tpu_gossip.ckpt`` (sharded
     atomic writes, manifest-gated torn-write detection, periodic in-run
     saves, bit-exact crash recovery — docs/checkpointing.md); its
-    loader accepts this format too (``ckpt.load_any``)."""
+    loader accepts this format too (``ckpt.load_any``).
+
+    Since the packed-plane PR the payload uses the PACKED storage
+    encoding (core/packed.py): the five (N, M) bool planes land as
+    LSB-first uint8 words, the six (N,) bool masks as one shared uint8
+    ``field_flags`` word — :func:`load_swarm` decodes it losslessly, and
+    still reads both older unpacked generations. The encode is the ONE
+    shared host codec (``pack_host_planes``) the sharded store's
+    format 3 also writes through."""
+    from tpu_gossip.core.packed import pack_host_planes
+
+    host = {}
     arrays = {}
     for f in dataclasses.fields(SwarmState):
         leaf = getattr(state, f.name)
         if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
             arrays[f"prngkey_{f.name}"] = np.asarray(jax.random.key_data(leaf))
         else:
-            arrays[f"field_{f.name}"] = np.asarray(leaf)
+            host[f.name] = np.asarray(leaf)
+    for name, arr in pack_host_planes(host).items():
+        arrays[f"field_{name}"] = arr
     np.savez(path, **arrays)
 
 
@@ -428,9 +472,22 @@ def load_swarm(path) -> SwarmState:
     to such a checkpoint treats the old epidemics as round-0 injections
     (docs/streaming_plane.md has the age-out consequence)."""
     data = np.load(path)
+    data = {k: data[k] for k in data.files}
     kwargs = {}
     _GROWTH_FIELDS = ("join_round", "admitted_by", "degree_credit")
-    if any(k.startswith("field_") or k.startswith("prngkey_") for k in data.files):
+    if "field_flags" in data:
+        # packed payload (the current save_swarm format): the ONE shared
+        # host decode (core/packed.py — the sharded store reads format 3
+        # through the same helper; absent planes fall through to the
+        # pre-plane default fills, forged dtypes stay undecoded for the
+        # named-plane validator). M comes off infected_round, which
+        # stays (N, M) at its declared int16.
+        from tpu_gossip.core.packed import decode_host_planes
+
+        data = decode_host_planes(
+            data, int(data["field_infected_round"].shape[-1])
+        )
+    if any(k.startswith("field_") or k.startswith("prngkey_") for k in data):
         for f in dataclasses.fields(SwarmState):
             if f"prngkey_{f.name}" in data:
                 kwargs[f.name] = jax.random.wrap_key_data(jnp.asarray(data[f"prngkey_{f.name}"]))

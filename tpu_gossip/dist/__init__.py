@@ -8,6 +8,7 @@ fan-out is one ``all_to_all`` inside ``shard_map``.
 """
 
 from tpu_gossip.dist._compat import shard_map_compat
+from tpu_gossip.dist.builder import matching_powerlaw_graph_dist
 from tpu_gossip.dist.matching_mesh import shard_matching_plan
 from tpu_gossip.dist.transport import IciRound, Transport, build_transport
 from tpu_gossip.dist.mesh import (
@@ -31,6 +32,7 @@ __all__ = [
     "Transport",
     "build_transport",
     "make_mesh",
+    "matching_powerlaw_graph_dist",
     "partition_graph",
     "build_shard_plans",
     "shard_swarm",

@@ -1,0 +1,310 @@
+"""Born-distributed matching builder: the graph never exists on one host.
+
+``matching_powerlaw_graph_sharded`` (core/matching_topology.py) lays the
+swarm out as S identical per-shard blocks — but it BUILDS globally: every
+stage table, the erasure sort, and the CSR sort materialize (R, 128) and
+(R·128,) arrays on one device before the state is ever sharded. At 10M
+that is ~1.5 GB of transient build arrays; at the 100M target it is the
+reason the ROADMAP calls the next order of magnitude "a memory and
+layout problem": the graph would have to exist on one host before it can
+be distributed.
+
+This module builds the SAME layout inside ``shard_map``: each shard
+derives its own table blocks (``fold_in(stage_key, shard)`` — the
+``block_keys=True`` derivation of ``_build_plan``, which is the layout
+truth this builder is conformance-tested against bit for bit), computes
+its owner/validity planes from the shared ``local_classes``, runs the
+partner passes through the SAME sharded pipeline the round engine uses
+(``kernels.permute.apply_pipeline`` with per-transpose ``all_to_all``),
+erases duplicates with a SHARD-LOCAL sort, and exports its own CSR
+segment against its own pad-row sentinel. Peak build memory is per-shard
+(O(R/S) per device); nothing global is ever materialized.
+
+Why the shard-local duplicate erasure is exact: an edge between u and v
+has one stub slot in u's shard and one in v's shard (slots are laid out
+by owner), and its erasure id ``cid = min(slot, partner_slot)`` is a
+property of the EDGE, identical from both sides. All parallel (u, v)
+edges therefore meet in u's shard (u-side slots) AND in v's shard
+(v-side slots), each shard sorts its side by (owner, partner, cid) and
+keeps the minimum-cid edge — both shards elect the same keeper, both
+sides of every loser get marked, and the final ``valid`` plane equals
+the global lexsort's bit for bit (tests/sim/test_dist_builder.py pins
+every leaf).
+
+The per-shard CSR is exact for the same layout reason: a shard's rows
+own exactly its slots' out-edges, erased edges absorb into the shard's
+OWN pad row, so the global stable sort by source row equals the
+concatenation of shard-local stable sorts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_gossip.core.device_topology import DeviceGraph
+from tpu_gossip.core.matching_topology import (
+    DEG_TABLE_CAP,
+    MatchingPlan,
+    expand_classes,
+    pipeline_stages,
+    reduce_classes,
+    sharded_layout,
+)
+from tpu_gossip.dist._compat import shard_map_compat
+from tpu_gossip.kernels.permute import apply_pipeline, inverse_tables
+
+__all__ = ["matching_powerlaw_graph_dist"]
+
+AXIS = "peers"
+
+
+def matching_powerlaw_graph_dist(
+    n: int,
+    mesh: Mesh,
+    gamma: float = 2.5,
+    d_min: int = 2,
+    d_max: int | None = None,
+    *,
+    fanout: int | None = None,
+    key: jax.Array | None = None,
+    interpret: bool | None = None,
+    export_csr: bool = True,
+    growth_rows: int = 0,
+) -> tuple[DeviceGraph, MatchingPlan]:
+    """Build the sharded matching swarm BORN on the mesh.
+
+    Bit-identical to ``matching_powerlaw_graph_sharded(n, mesh.size,
+    ..., block_keys=True)`` on every plan leaf and graph array (the
+    conformance contract — the checkpoint resharding contract run
+    forward), with per-shard peak build memory: each device materializes
+    only its ``per_rows`` slot-row block of every table and its own CSR
+    segment. Every returned array is already placed with the peer-axis
+    sharding the round engines expect, so ``shard_matching_plan`` is a
+    no-op re-placement and the 100M graph never has to exist on one
+    host.
+    """
+    if key is None:
+        key = jax.random.key(0)
+    s = int(mesh.size)
+    if s < 1 or 128 % s:
+        raise ValueError(
+            f"mesh size {s} must divide 128 (the transpose all_to_all "
+            "splits the lane axis)"
+        )
+    if growth_rows < 0:
+        raise ValueError(f"growth_rows={growth_rows} must be >= 0")
+
+    # --- host planning: the ONE shared layout law (the conformance
+    # contract rests on planning the same layout the local builder does)
+    lay = sharded_layout(n, s, gamma, d_min, d_max, growth_rows)
+    d_max, n_per, deg_local = lay["d_max"], lay["n_per"], lay["deg_local"]
+    local_classes, per_rows = lay["local_classes"], lay["per_rows"]
+    rows, n_blk, n_state = lay["rows"], lay["n_blk"], lay["n_state"]
+    n_stages = lay["n_stages"]
+    per_slots = per_rows * 128
+    tdt = jnp.int8 if lay["int8_tables"] else jnp.int32
+    deg_dt = jnp.int16 if d_max <= DEG_TABLE_CAP else jnp.int32
+
+    # stage keys split OUTSIDE the mesh (replicated); each shard folds its
+    # index in — exactly _build_plan's block_keys derivation. Raw key data
+    # crosses the shard_map boundary (extended dtypes do not).
+    keys = jax.random.split(key, n_stages + 1)
+    key_data = jax.random.key_data(keys)  # (n_stages+1, 2) uint32
+    deg_blk = jnp.concatenate([
+        jnp.asarray(deg_local, dtype=jnp.int32),
+        jnp.zeros((growth_rows + 1,), jnp.int32),
+    ])  # identical for every shard: replicated operand
+
+    @functools.partial(
+        shard_map_compat,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(
+            tuple(P(AXIS) for _ in range(n_stages)),  # lanes
+            P(AXIS),  # m3
+            tuple(P(AXIS) for _ in range(n_stages)),  # lanes_inv
+            P(AXIS),  # valid
+            P(AXIS),  # deg_other
+            P(AXIS),  # deg_real (n_state,)
+            P(AXIS),  # row_ptr blocks (n_state,) — total appended outside
+            P(AXIS),  # col_idx (rows*128,)
+        ),
+        check_vma=False,
+    )
+    def build(kd, deg_b):
+        sh = jax.lax.axis_index(AXIS)
+        skeys = jax.random.wrap_key_data(kd)
+
+        def table(i):
+            return jnp.argsort(jax.random.uniform(
+                jax.random.fold_in(skeys[i], sh), (per_rows, 128)
+            ), axis=1)
+
+        lanes_blk = tuple(table(i).astype(tdt) for i in range(n_stages))
+        p = table(n_stages).astype(jnp.int32)
+        a, b = p[:, 0::2], p[:, 1::2]
+        rows_ix = jnp.arange(per_rows, dtype=jnp.int32)[:, None]
+        m3_blk = (
+            jnp.zeros((per_rows, 128), jnp.int32)
+            .at[rows_ix, a].set(b)
+            .at[rows_ix, b].set(a)
+        ).astype(tdt)
+        lanes_inv_blk = tuple(inverse_tables(ln) for ln in lanes_blk)
+        stages = pipeline_stages(lanes_blk, m3_blk, lanes_inv_blk)
+
+        def partner(x):
+            return apply_pipeline(
+                x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+            )
+
+        # --- per-slot plan vectors, block-local --------------------------
+        # `owner` at DEAD slots (alignment gaps, block tails) differs from
+        # the global build's literal-zero gap fill, but every output is
+        # gated on `real`/`valid`, which those slots can never enter —
+        # the conformance test pins leaf equality, proving the gate holds
+        node_base = sh * n_blk
+        owner = expand_classes(
+            jnp.arange(n_blk, dtype=jnp.int32), local_classes, per_rows
+        ) + node_base
+        flat = (
+            sh * per_slots
+            + jnp.arange(per_slots, dtype=jnp.int32).reshape(per_rows, 128)
+        )
+        real_flat = jnp.zeros((per_slots,), bool)
+        for node_off, slot_off, count, pad_deg, cstride in local_classes:
+            d = jax.lax.dynamic_slice_in_dim(deg_b, node_off, count)
+            if count >= 8192:  # _POS_MAJOR_MIN
+                pos = jnp.arange(pad_deg, dtype=jnp.int32)[:, None]
+                if cstride != count:
+                    d = jnp.concatenate(
+                        [d, jnp.zeros((cstride - count,), d.dtype)]
+                    )
+                mask = (pos < d[None, :]).reshape(-1)
+            else:
+                pos = jnp.arange(pad_deg, dtype=jnp.int32)[None, :]
+                mask = (pos < d[:, None]).reshape(-1)
+            real_flat = jax.lax.dynamic_update_slice_in_dim(
+                real_flat, mask, slot_off, axis=0
+            )
+        real = real_flat.reshape(per_rows, 128)
+
+        # --- partner-side quantities: sharded pipeline passes ------------
+        part = partner(flat)
+        other_owner = partner(owner)
+        partner_real = partner(real.astype(jnp.int32)) > 0
+        alive = (
+            real & partner_real & (other_owner != owner)
+            & (other_owner < n_state)
+        )
+
+        # --- duplicate erasure, SHARD-LOCAL sort (see module docstring) --
+        cid = jnp.minimum(flat, part).reshape(-1)
+        u = jnp.where(alive, owner, n_state).reshape(-1)
+        v = jnp.where(alive, other_owner, n_state).reshape(-1)
+        order = jnp.lexsort((cid, v, u))
+        su, sv = u[order], v[order]
+        dup_sorted = jnp.zeros_like(su, dtype=bool).at[1:].set(
+            (su[1:] == su[:-1]) & (sv[1:] == sv[:-1]) & (su[1:] != n_state)
+        )
+        dup = (
+            jnp.zeros((per_slots,), bool)
+            .at[order].set(dup_sorted)
+            .reshape(per_rows, 128)
+        )
+        dup_both = dup | (partner(dup.astype(jnp.int32)) > 0)
+        valid = alive & ~dup_both
+
+        # --- realized + partner degrees ----------------------------------
+        deg_i32 = reduce_classes(
+            valid.astype(jnp.int32), local_classes, n_blk, "sum"
+        )
+        deg_other = partner(
+            expand_classes(deg_i32, local_classes, per_rows)
+        )
+        if deg_dt == jnp.int16:
+            deg_real = jnp.minimum(deg_i32, DEG_TABLE_CAP).astype(deg_dt)
+            deg_other = jnp.minimum(deg_other, DEG_TABLE_CAP).astype(deg_dt)
+        else:
+            deg_real = deg_i32
+
+        # --- CSR segment against the shard's OWN pad-row sentinel --------
+        sent = node_base + n_blk - 1
+        if export_csr:
+            src = jnp.where(valid.reshape(-1), owner.reshape(-1), sent)
+            dst = jnp.where(
+                valid.reshape(-1), other_owner.reshape(-1), sent
+            )
+            csr_order = jnp.argsort(src)
+            col_blk = dst[csr_order]
+            # global row_ptr[i] for i in this block = (full blocks before
+            # me) + local count below i — earlier shards' sources are all
+            # < my node range, later shards' all above
+            rp_blk = (
+                sh * per_slots
+                + jnp.searchsorted(
+                    src[csr_order],
+                    node_base + jnp.arange(n_blk, dtype=jnp.int32),
+                    side="left",
+                ).astype(jnp.int32)
+            )
+        else:
+            total = jnp.sum(deg_i32, dtype=jnp.int32)
+            totals = jax.lax.all_gather(total, AXIS)
+            base = jnp.sum(
+                jnp.where(jnp.arange(s) < sh, totals, 0), dtype=jnp.int32
+            )
+            rp_blk = base + jnp.concatenate([
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(deg_i32, dtype=jnp.int32)[:-1],
+            ])
+            col_blk = jnp.zeros((per_slots,), jnp.int32)
+
+        return (
+            lanes_blk, m3_blk, lanes_inv_blk, valid, deg_other,
+            deg_real, rp_blk, col_blk,
+        )
+
+    (
+        lanes, m3, lanes_inv, valid, deg_other, deg_real, rp_blocks, col_all,
+    ) = build(key_data, deg_blk)
+
+    if export_csr:
+        row_ptr = jnp.concatenate([
+            rp_blocks,
+            jnp.asarray([rows * 128], dtype=jnp.int32),
+        ])
+        col_idx = col_all
+    else:
+        e_total = jnp.sum(
+            deg_real.astype(jnp.int32)
+            if deg_real.dtype != jnp.int32 else deg_real,
+            dtype=jnp.int32,
+        )
+        row_ptr = jnp.concatenate([rp_blocks, e_total[None]])
+        col_idx = jnp.zeros((1,), jnp.int32)
+
+    classes = tuple(
+        (sh * n_blk + no, sh * per_slots + so, c, pd, cs)
+        for sh in range(s)
+        for (no, so, c, pd, cs) in local_classes
+    )
+    plan = MatchingPlan(
+        lanes=lanes, m3=m3, lanes_inv=lanes_inv, valid=valid,
+        deg_other=deg_other, deg_real=deg_real,
+        n=n_state, rows=rows, classes=classes, fanout=fanout,
+        mesh_shards=s, n_per=n_per, n_blk=n_blk, per_rows=per_rows,
+        local_classes=local_classes,
+    )
+    exists = jax.device_put(
+        jnp.asarray((np.arange(n_state) % n_blk) < n_per),
+        NamedSharding(mesh, P(AXIS)),
+    )
+    graph = DeviceGraph(
+        row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n_state - 1
+    )
+    return graph, plan

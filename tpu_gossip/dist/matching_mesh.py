@@ -303,7 +303,7 @@ def _matching_exchange_dist(
             thresh_q = jnp.where(
                 valid_blk & (deg_self > 0),
                 bernoulli_threshold_device(
-                    1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)
+                    1.0 / jnp.maximum(deg_self, 1).astype(jnp.float32)  # graftlint: disable=mem-widening-cast -- int16 degree table widening transiently into the f32 Bernoulli law; exact under DEG_TABLE_CAP, gates bit-identical to the local kernel's
                 ),
                 jnp.uint32(0),
             )

@@ -1057,18 +1057,28 @@ def simulate_dist(
     ``collect_ici`` (static) returns ``(state, (stats, ici))`` with the
     per-round analytic ICI word trajectory stacked alongside the stats.
     ``stream`` threads a compiled streaming workload (traffic/) exactly
-    as in the local engine.
+    as in the local engine. A :class:`~tpu_gossip.core.packed.
+    PackedSwarm` input keeps the scan CARRY packed (the sharded resident
+    state between rounds is the registry's packed storage ledger) while
+    each round runs unpack -> the identical mesh round -> repack — the
+    pack is row-parallel, so the packed pytree keeps the peer-axis
+    sharding and the packed mesh trajectory is bit-identical to the
+    unpacked one (and, transitively, to the local engine's).
     """
+    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
+
+    packed = is_packed(state)
 
     def body(carry, _):
-        out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
+        st = unpack_state(carry) if packed else carry
+        out = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                 scenario, growth, transport, collect_ici,
                                 stream, control, pipeline, liveness)
         if collect_ici:
             nxt, stats, ici = out
-            return nxt, (stats, ici)
+            return (pack_state(nxt) if packed else nxt), (stats, ici)
         nxt, stats = out
-        return nxt, stats
+        return (pack_state(nxt) if packed else nxt), stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
 
@@ -1113,17 +1123,24 @@ def run_until_coverage_dist(
     """
     from tpu_gossip.dist.transport import accumulate_ici, zero_ici_totals
 
-    def cond_plain(st: SwarmState) -> jax.Array:
+    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
+
+    packed = is_packed(state)
+
+    def cond_plain(st) -> jax.Array:
+        # PackedSwarm reads coverage off its packed words (one bit
+        # column); the definition matches SwarmState.coverage exactly
         return (st.coverage(slot) < target) & (st.round - state.round < max_rounds)
 
     if not collect_ici:
 
-        def body(st: SwarmState) -> SwarmState:
-            nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
+        def body(st):
+            nxt, _ = gossip_round_dist(unpack_state(st) if packed else st,
+                                       cfg, sg, mesh, shard_plan,
                                        scenario, growth, transport,
                                        stream=stream, control=control,
                                        pipeline=pipeline, liveness=liveness)
-            return nxt
+            return pack_state(nxt) if packed else nxt
 
         return jax.lax.while_loop(cond_plain, body, state)
 
@@ -1132,9 +1149,10 @@ def run_until_coverage_dist(
 
     def body_ici(carry):
         st, acc = carry
-        nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
+        nxt, _, ici = gossip_round_dist(unpack_state(st) if packed else st,
+                                        cfg, sg, mesh, shard_plan,
                                         scenario, growth, transport, True,
                                         stream, control, pipeline, liveness)
-        return nxt, accumulate_ici(acc, ici)
+        return (pack_state(nxt) if packed else nxt), accumulate_ici(acc, ici)
 
     return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))

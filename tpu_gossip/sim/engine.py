@@ -1096,14 +1096,27 @@ def simulate(
     compiled control policy (control/) — the level cursor in the carry
     is its cursor, and the stacked stats carry the control track
     (sim.metrics.reliability_report consumes it).
+
+    PACKED runs: pass a :class:`~tpu_gossip.core.packed.PackedSwarm`
+    (``pack_state(state)``) and the scan CARRY stays packed — the
+    resident inter-round state is the registry's packed storage ledger
+    (67 B/peer at m=16 vs 142 unpacked) — while each round body runs
+    unpack -> the identical round program -> repack, so the packed
+    trajectory is bit-identical to the unpacked one (test-pinned across
+    the composed scenario×growth×stream×control×pipeline×adversary
+    matrix). The return is packed too; ``unpack_state`` reads it.
     """
+    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
+
+    packed = is_packed(state)
 
     def body(carry, _):
-        nxt, stats = gossip_round(carry, cfg, plan, tail=tail,
-                                  scenario=scenario, growth=growth,
-                                  stream=stream, control=control,
-                                  pipeline=pipeline, liveness=liveness)
-        return nxt, stats
+        nxt, stats = gossip_round(unpack_state(carry) if packed else carry,
+                                  cfg, plan, tail=tail, scenario=scenario,
+                                  growth=growth, stream=stream,
+                                  control=control, pipeline=pipeline,
+                                  liveness=liveness)
+        return (pack_state(nxt) if packed else nxt), stats
 
     return jax.lax.scan(body, state, None, length=num_rounds)
 
@@ -1145,15 +1158,26 @@ def run_until_coverage(
     (traffic/) — note the stop condition still reads ``coverage(slot)``,
     which a recycled slot resets; steady-state measurement wants the
     fixed-horizon :func:`simulate` instead (the CLI enforces this).
-    """
 
-    def cond(s: SwarmState) -> jax.Array:
+    PACKED runs (see :func:`simulate`): a
+    :class:`~tpu_gossip.core.packed.PackedSwarm` input keeps the while
+    CARRY packed; the predicate reads coverage straight off the packed
+    words (``PackedSwarm.coverage`` — one bit column, no plane unpack)
+    and the body runs unpack -> round -> repack, bit-identical to the
+    unpacked loop.
+    """
+    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
+
+    packed = is_packed(state)
+
+    def cond(s) -> jax.Array:
         return (s.coverage(slot) < target) & (s.round - state.round < max_rounds)
 
-    def body(s: SwarmState) -> SwarmState:
-        nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
-                              growth=growth, stream=stream, control=control,
+    def body(s):
+        nxt, _ = gossip_round(unpack_state(s) if packed else s, cfg, plan,
+                              tail=tail, scenario=scenario, growth=growth,
+                              stream=stream, control=control,
                               pipeline=pipeline, liveness=liveness)
-        return nxt
+        return pack_state(nxt) if packed else nxt
 
     return jax.lax.while_loop(cond, body, state)
