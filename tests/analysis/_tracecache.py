@@ -1,0 +1,17 @@
+"""Process-wide trace cache shared by the analysis test modules.
+
+Tracing the entry-point matrix (make_jaxpr per entry) is the dominant
+compile cost in tests/analysis — test_mem, test_collectives and
+test_liveness all walk the same TracedEntry objects, which are pure
+values once built. Sharing ONE cache dict across the modules means each
+entry is traced once per pytest process instead of once per module
+(tier-1 wall budget; ISSUE 17 satellite: session-scope the heaviest
+compile fixtures).
+
+Not a conftest fixture on purpose: trace_matrix already takes a plain
+``cache`` dict, so a shared module-level dict is the whole mechanism —
+no fixture plumbing, and direct `python -m pytest tests/analysis/<one
+file>` runs keep working unchanged.
+"""
+
+CACHE: dict = {}

@@ -1,7 +1,12 @@
 """Baseline round-trip + new/old partition semantics (the py3.10-compatible
 minimal TOML subset in analysis/baseline.py)."""
 
-from tpu_gossip.analysis.baseline import load_baseline, split_new, write_baseline
+from tpu_gossip.analysis.baseline import (
+    load_baseline,
+    load_baseline_entries,
+    split_new,
+    write_baseline,
+)
 from tpu_gossip.analysis.registry import Finding
 
 
@@ -107,3 +112,44 @@ def test_duplicate_entries_deduped(tmp_path):
     p = tmp_path / "b.toml"
     write_baseline(p, [_f("a.py", "r", "m"), _f("a.py", "r", "m", line=9)])
     assert p.read_text().count("[[finding]]") == 1
+
+
+def test_write_is_deterministically_ordered(tmp_path):
+    """Entries sort by (rule, file, line) regardless of input order — the
+    property that makes a regenerated baseline diff cleanly against the
+    committed one instead of churning with scan order."""
+    p1, p2 = tmp_path / "a.toml", tmp_path / "b.toml"
+    findings = [
+        _f("z.py", "trace-purity", "m1", line=9, qualname="f1"),
+        _f("a.py", "trace-purity", "m2", line=2, qualname="f2"),
+        _f("a.py", "trace-purity", "m3", line=40, qualname="f3"),
+        _f("m.py", "key-linearity", "m4", line=1, qualname="f4"),
+    ]
+    write_baseline(p1, findings)
+    write_baseline(p2, list(reversed(findings)))
+    assert p1.read_text() == p2.read_text()
+    entries = load_baseline_entries(p1)
+    keys = [(e.rule, e.file, e.line) for e in entries]
+    assert keys == sorted(keys)
+    assert keys[0][0] == "key-linearity"  # rule is the primary column
+
+
+def test_write_load_write_fixed_point(tmp_path):
+    """write→load→write is a fixed point: every column the writer sorts
+    by is a column it serializes, so regenerating from a loaded baseline
+    reproduces the file byte-for-byte."""
+    p1, p2 = tmp_path / "a.toml", tmp_path / "b.toml"
+    findings = [
+        _f("b.py", "trace-purity", 'tricky "quoted" \\ msg\nnewline',
+           line=7),
+        _f("a.py", "trace-purity", "same rule+file, later line", line=30,
+           qualname="g"),
+        _f("a.py", "trace-purity", "same rule+file, early line", line=4,
+           qualname="f"),
+        _f("a.py", "key-linearity", "other rule", line=11, qualname="h"),
+    ]
+    write_baseline(p1, findings)
+    write_baseline(p2, load_baseline_entries(p1))
+    assert p1.read_text() == p2.read_text()
+    # and the identity set is unchanged through the cycle
+    assert load_baseline(p1) == load_baseline(p2)

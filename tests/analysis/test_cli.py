@@ -4,6 +4,8 @@ interface CI and bench.py depend on."""
 import json
 from pathlib import Path
 
+import pytest
+
 from tpu_gossip.analysis.cli import main, run_repo_lint
 
 FIXTURES = Path(__file__).parent / "fixtures"
@@ -78,6 +80,8 @@ def test_deep_flag_on_explicit_paths_lints_ast_side(capsys):
     assert rc == 0
 
 
+@pytest.mark.slow  # whole-tree AST walk just to accept a flag; CI's lint
+# job passes --fail-on-new on every push
 def test_fail_on_new_flag_accepted(capsys):
     rc = main(["--no-contracts", "--fail-on-new"])
     capsys.readouterr()
@@ -112,6 +116,8 @@ def test_write_and_respect_baseline(tmp_path, capsys):
     capsys.readouterr()
 
 
+@pytest.mark.slow  # whole-tree walk; the API shape is pinned here, the
+# clean-tree claim is CI's lint job every push
 def test_run_repo_lint_programmatic():
     out = run_repo_lint()
     assert out["clean"] is True, out["new"]

@@ -1,0 +1,116 @@
+"""deep-transient-liveness (analysis/deep/liveness).
+
+Pins (a) the attribution sweep is the GRAFTMEM sweep: peak bytes equal
+entry_ledger's exactly (acceptance asks within 5%; identity is the
+stronger pin) for the packed entries; (b) the attribution names the
+core/packed.py codec (unpack_bits) as the packed entries' peak-live
+driver — ROADMAP's "unpack spike" as a file:line; (c) the codec rail:
+the real packed entries are clean, the deliberate out-of-codec decode
+fixture fires, and structural ops alone never fire.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_gossip.analysis.deep.liveness import (
+    RULE,
+    codec_findings,
+    entry_liveness,
+    liveness_findings,
+)
+from tpu_gossip.analysis.deep.selftest import unpack_spike_entry
+from tpu_gossip.analysis.entrypoints import entry_points, trace_matrix
+from tpu_gossip.analysis.mem.ledger import entry_ledger
+from tpu_gossip.core.packed import pack_bits
+
+EPS = {ep.name: ep for ep in entry_points()}
+PACKED_LOCAL = [
+    n for n, ep in EPS.items()
+    if getattr(ep, "packed", False) and n.startswith("local[")
+]
+
+
+# one process-wide trace cache: every test reads the same TracedEntry
+# instead of re-paying make_jaxpr (tier-1 wall budget)
+from tests.analysis._tracecache import CACHE as _CACHE
+
+
+def _traced(name):
+    return trace_matrix([EPS[name]], cache=_CACHE)[name]
+
+
+def test_matrix_declares_packed_entries():
+    assert PACKED_LOCAL, "no packed local entries in the matrix"
+
+
+@pytest.mark.parametrize("name", sorted(PACKED_LOCAL))
+def test_peak_equals_ledger_and_names_the_codec(name):
+    """One sweep, two reports: the liveness peak IS the ledger peak
+    (same `_analyze`, different labeler), and the top attribution for a
+    packed local entry is the core/packed.py decode line — the unpack
+    spike, named."""
+    te = _traced(name)
+    live = entry_liveness(name, te)
+    ledger = entry_ledger(name, te)
+    assert live is not None and ledger is not None
+    assert live["peak_bytes"] == ledger.peak_bytes
+    # acceptance phrasing: within 5% of graftmem's number
+    assert abs(live["peak_bytes"] - ledger.peak_bytes) <= (
+        0.05 * ledger.peak_bytes
+    )
+    top_label = live["top"][0][0]
+    assert "tpu_gossip/core/packed.py" in top_label, live["top"]
+    assert "unpack_bits" in top_label, live["top"]
+
+
+def test_labels_are_file_lines_not_prims():
+    """The point of the pass: intermediates attribute to repo source
+    lines, not `intermediate:<prim>` buckets."""
+    name = PACKED_LOCAL[0]
+    live = entry_liveness(name, _traced(name))
+    assert not any(
+        lbl.startswith("intermediate:") for lbl, _ in live["top"]
+    ), live["top"]
+
+
+# ------------------------------------------------------------- codec rail
+def test_real_packed_entries_are_clean():
+    packed = [ep for ep in entry_points() if getattr(ep, "packed", False)]
+    traced = trace_matrix(packed, cache=_CACHE)
+    findings = liveness_findings(traced)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_out_of_codec_decode_fires():
+    name, te = unpack_spike_entry()
+    findings = codec_findings(name, te)
+    assert any(
+        f.rule == RULE and f.file.endswith("selftest.py") for f in findings
+    ), [f.render() for f in findings]
+    # the finding names a real decode primitive with its output shape
+    assert any("shift" in f.message or "and" in f.message for f in findings)
+
+
+def test_structural_moves_do_not_fire():
+    """Reshaping/slicing packed words (routing them around) is not a
+    decode — only COMPUTING on their bits outside the codec is."""
+    words = pack_bits((jnp.arange(32 * 16) % 3 == 0).reshape(32, 16))
+
+    def mover(state):
+        w = state["seen"]
+        return jnp.transpose(w)[:1].reshape(-1)
+
+    name, te = "synthetic[mover]", None
+    from tpu_gossip.analysis.entrypoints import EntryPoint, TracedEntry
+
+    ep = EntryPoint(
+        name=name, engine="synthetic", kind="round",
+        audit_check="synthetic", build=lambda: (mover, {"seen": words}),
+        n_peers=32, packed=True,
+    )
+    te = TracedEntry(ep=ep, state={"seen": words})
+    te.jaxpr, te.out_shape = jax.make_jaxpr(mover, return_shape=True)(
+        {"seen": words}
+    )
+    assert codec_findings(name, te) == []

@@ -36,11 +36,13 @@ from tpu_gossip.analysis.mem.widths import (
 )
 from tpu_gossip.analysis.mem.wire import wire_findings
 
+from tests.analysis._tracecache import CACHE as _CACHE
+
 EPS = {ep.name: ep for ep in entry_points()}
 
 
 def _traced(name):
-    return trace_matrix([EPS[name]])[name]
+    return trace_matrix([EPS[name]], cache=_CACHE)[name]
 
 
 # ----------------------------------------------------------- micro ledger
@@ -238,7 +240,7 @@ def test_skewed_wire_counter_detected(monkeypatch):
     """Skew the bucketed engine's wire declaration: mem-wire-drift."""
     from tpu_gossip.dist import mesh as mesh_mod
 
-    traced = trace_matrix([EPS["dist[bucketed]"]])
+    traced = trace_matrix([EPS["dist[bucketed]"]], cache=_CACHE)
     clean, report = wire_findings(traced)
     assert clean == [] and report["dist[bucketed]"]["traced_words"] == \
         report["dist[bucketed]"]["declared_words"]

@@ -1,0 +1,64 @@
+"""Whole-tree pragma audit (ISSUE 17 satellite): every
+``# graftlint: disable=`` pragma in the lint scope must name rules the
+registry actually owns AND carry a ``-- reason``.
+
+run_rules already reports ``pragma-needs-reason`` / ``pragma-unknown-rule``
+per module, but only for modules a lint run visits and only as findings a
+baseline could swallow. This audit is the backstop that cannot be
+baselined: it walks the full default scope directly and FAILS the suite on
+any stale pragma — a suppression that names a renamed/removed rule
+silently suppresses nothing, which is worse than a loud finding.
+"""
+
+import functools
+
+from tpu_gossip.analysis.cli import _DEFAULT_SCOPE, modules_for, repo_root
+from tpu_gossip.analysis.registry import DEEP_RULES, MEM_RULES, RULES
+
+
+@functools.lru_cache(maxsize=1)  # one tree parse serves all three audits
+def _all_pragmas():
+    """(module_rel, line, Pragma) for every pragma in the lint scope,
+    deduped (comment-line pragmas register on two lines)."""
+    out = []
+    for m in modules_for(repo_root(), list(_DEFAULT_SCOPE)):
+        seen = set()
+        for line, prag in sorted(m.pragmas.items()):
+            if id(prag) in seen:
+                continue
+            seen.add(id(prag))
+            out.append((m.rel, line, prag))
+    return tuple(out)
+
+
+def test_tree_has_pragmas_to_audit():
+    # the audit below must not vacuously pass because the walker broke
+    assert _all_pragmas(), "pragma walker found no pragmas in the tree"
+
+
+def test_every_pragma_names_a_registered_rule():
+    known = (
+        set(RULES) | set(DEEP_RULES) | set(MEM_RULES)
+        | {"*", "pragma-needs-reason"}
+    )
+    stale = [
+        f"{rel}:{line}: {','.join(sorted(prag.rules - known))}"
+        for rel, line, prag in _all_pragmas()
+        if prag.rules - known
+    ]
+    assert not stale, (
+        "stale pragmas naming unregistered rules (they suppress NOTHING "
+        "— delete or rename them):\n" + "\n".join(stale)
+    )
+
+
+def test_every_pragma_carries_a_reason():
+    bare = [
+        f"{rel}:{line}: disable={','.join(sorted(prag.rules))}"
+        for rel, line, prag in _all_pragmas()
+        if not prag.reason
+    ]
+    assert not bare, (
+        "pragmas without a `-- reason` (the next reader deserves the "
+        "why):\n" + "\n".join(bare)
+    )

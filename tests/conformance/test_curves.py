@@ -20,6 +20,7 @@ import functools
 import socket as socketlib
 
 import numpy as np
+import pytest
 
 from tpu_gossip.compat.peer import PeerNode
 from tpu_gossip.compat.simnet import SimCluster
@@ -153,6 +154,8 @@ async def test_socket_vs_sim_curves_agree(tmp_path):
     assert np.max(np.abs(sock[mid] - np.mean(sims, axis=0)[mid])) <= 0.35
 
 
+@pytest.mark.slow  # 1000 real sockets; the 40-peer curve above keeps the
+# socket-vs-sim conformance law in tier-1
 @asyncio_test
 async def test_socket_vs_sim_curves_agree_1k(tmp_path):
     """The north-star conformance criterion at its stated scale
@@ -205,6 +208,8 @@ def test_sim_curve_deterministic():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow  # cross-family statistical sweep; unit-level matching
+# twins and the socket conformance curve carry tier-1
 def test_matching_vs_device_family_curves_agree():
     """Cross-family conformance: the structured-matching generator and the
     sort-based device generator sample the SAME erased configuration model

@@ -26,6 +26,7 @@ import socket as socketlib
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_gossip.compat.peer import PeerNode
 from tpu_gossip.compat.seed import SeedNode
@@ -116,6 +117,8 @@ def sim_growth_degrees(n_final, seed) -> np.ndarray:
     )[: n_final]
 
 
+@pytest.mark.slow  # 3-seed socket bootstrap sweep; the socket-vs-sim curve
+# keeps socket conformance in tier-1
 @asyncio_test
 async def test_socket_bootstrap_vs_growth_engine_degrees(tmp_path):
     sock_deg = await socket_bootstrap_degrees(tmp_path, N_SWARM)

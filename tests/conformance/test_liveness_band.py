@@ -65,7 +65,11 @@ def _detection_round(cfg: SwarmConfig, rounds: int = 12,
     return int(hit[0]) + 1
 
 
-@pytest.mark.parametrize("factor", [1.0, 0.01], ids=["reference", "scaled-100x"])
+@pytest.mark.parametrize(
+    "factor",
+    [1.0, pytest.param(0.01, marks=pytest.mark.slow)],
+    ids=["reference", "scaled-100x"],
+)  # the unscaled row carries tier-1; scaling cancels in every ratio
 def test_detection_latency_inside_reference_band(factor):
     timing = ProtocolTiming().scaled(factor)
     cfg = _cfg_from_timing(timing)
@@ -83,7 +87,11 @@ def test_detection_latency_inside_reference_band(factor):
     )
 
 
-@pytest.mark.parametrize("quorum_k", [2, 3, 7])
+@pytest.mark.parametrize(
+    "quorum_k",
+    [2, pytest.param(3, marks=pytest.mark.slow),
+     pytest.param(7, marks=pytest.mark.slow)],
+)  # one quorum point witnesses the band in tier-1
 def test_quorum_detection_stays_inside_reference_band(quorum_k):
     """The defense cannot cost the parity contract: with no adversaries
     and quorum_k > 1, the hardened detector's latency must still land

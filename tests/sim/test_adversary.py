@@ -112,6 +112,8 @@ def test_quorum_spec_validation():
 
 
 # ------------------------------------------- determinism anchor contracts
+@pytest.mark.slow  # direct-detector parity anchor pair rides the slow
+# lane; quorum semantics keep six cheaper tier-1 tests below
 def test_quorum_k1_no_adversary_bit_identical_to_direct_detector():
     """THE determinism anchor: quorum_k=1 with no adversaries reproduces
     the unhardened detector bit for bit — the FULL state, suspicion
@@ -131,6 +133,8 @@ def test_quorum_k1_no_adversary_bit_identical_to_direct_detector():
     assert int(stats_direct.n_declared_dead[-1]) == 20  # it actually bit
 
 
+@pytest.mark.slow  # latency anchor; the k=1 bit-identity anchor above is
+# the tier-1 representative of direct-detector parity
 def test_quorum_detection_latency_equals_direct_detector():
     """The witness cohort confirms a genuinely-stale suspect in ONE
     sweep, so for any quorum_k up to the live witness count the hardened
@@ -244,6 +248,8 @@ def test_blacked_out_adversaries_emit_nothing():
     assert int(np.asarray(stats.adv_forged).sum()) == 0
 
 
+@pytest.mark.slow  # credit-book composition; quarantine + accusation
+# invariants stay in tier-1 via the cheaper quorum tests
 def test_quarantine_releases_rewire_credit_book_balance():
     """A quarantined row's fresh edges are discarded: its stored targets'
     degree credit is RELEASED and the row leaves the re-wired set — the
@@ -266,6 +272,8 @@ def test_quarantine_releases_rewire_credit_book_balance():
     assert int(np.asarray(fin.degree_credit).sum()) == stored
 
 
+@pytest.mark.slow  # forger-lane composition; forged-heartbeat billing is
+# asserted in tier-1 by the flood/replay billing test
 def test_forgery_stalls_detection_entry_but_not_active_suspicion():
     """Forged heartbeats refresh non-suspected targets' last_hb, delaying
     suspicion ENTRY of the genuinely silent — detection falls far behind
@@ -398,6 +406,8 @@ def test_partial_suspicion_planes_never_silently_zeroed(tmp_path):
 
 
 # ------------------------------------------------ the demonstration pair
+@pytest.mark.slow  # the demonstration pair is narrative, not a contract;
+# the quorum/forgery invariant tests above carry tier-1
 def test_byzantine_siege_demonstration_pair():
     """THE acceptance pin: under scenarios/byzantine_siege.toml with
     traffic and control, the unhardened detector (quorum_k=1 — the
@@ -442,6 +452,8 @@ def test_byzantine_siege_demonstration_pair():
 
 
 # --------------------------------------------------------------- fleet
+@pytest.mark.slow  # fleet x adversary composition; fleet lane parity and
+# solo adversary runs each stay in tier-1 on their own
 def test_fleet_adversary_lane_bit_identical_to_solo():
     """The fleet extension: a byzantine campaign ([base] quorum_k) keeps
     the lane↔solo bit-identity contract — the QuorumSpec is jit-static

@@ -193,6 +193,8 @@ def test_retention_prunes_oldest(tmp_path, warm_state):
 
 
 # ------------------------------------------------- crash-resume parity
+@pytest.mark.slow  # the composed-matrix resume; single-feature resume
+# parity stays in tier-1 via the CLI checkpoint/remat tests
 def test_resume_bit_identity_composed_local(tmp_path):
     """Interrupted-and-resumed == uninterrupted, bit for bit, on the
     composed scenario×growth×stream×control cell (the mid-flight cursor
@@ -252,6 +254,8 @@ def test_resume_bit_identity_composed_local(tmp_path):
             np.testing.assert_array_equal(sd[f], arr, err_msg=f)
 
 
+@pytest.mark.slow  # cross-topology restore; test_sharded_roundtrip_is_bit_
+# exact keeps the sharded save/load law in tier-1
 def test_sharded_matching_save_local_load_bit_identity(tmp_path):
     """The resharding contract's S'=1 leg at small n: a mesh-run
     sharded-matching swarm checkpointed at S=8 files restores into the
@@ -475,6 +479,8 @@ def test_cli_checkpointed_run_resumes_bit_identically(tmp_path, capsys):
     assert res["stats_digest"] == ref["stats_digest"]
 
 
+@pytest.mark.slow  # remat x resume composition; the plain CLI resume test
+# keeps the crash-resume law in tier-1
 def test_cli_remat_run_resumes_bit_identically(tmp_path, capsys):
     """The local remat epoch loop composes with checkpointing: fold
     boundaries and checkpoint boundaries interleave, and a resumed run

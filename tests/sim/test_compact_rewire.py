@@ -7,6 +7,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_gossip import SwarmConfig, build_csr, init_swarm
 from tpu_gossip.core.state import clone_state
@@ -107,6 +108,8 @@ def test_compact_caps_joiner_rewiring_per_round():
     )
 
 
+@pytest.mark.slow  # full-curve comparison; the kernel-path semantics test
+# below is the tier-1 compact-rewire witness
 def test_compact_curves_match_dense_paths():
     """Statistical parity: BASELINE config 5 dynamics through the compact
     side paths (kernel delivery) match the dense XLA path — median

@@ -93,7 +93,10 @@ def test_compile_control_validates():
 # -------------------------------------------------- off-switch / identity
 
 
-@pytest.mark.parametrize("mode", ["push", "push_pull"])
+@pytest.mark.parametrize(
+    "mode",
+    ["push", pytest.param("push_pull", marks=pytest.mark.slow)],
+)  # tier-1 keeps one off-switch mode; the pull lane rides the slow lane
 def test_zero_adjustment_is_bit_identical_to_uncontrolled(mode):
     """Bounds pinned to the static m + no refresh: the controlled run's
     PROTOCOL trajectory (state + stats) is the uncontrolled run's, bit
@@ -111,6 +114,8 @@ def test_zero_adjustment_is_bit_identical_to_uncontrolled(mode):
     assert np.all(np.asarray(sz.control_fanout) == 3)
 
 
+@pytest.mark.slow  # staircase/matching off-switch: the dense-path variant
+# above is the tier-1 representative of the same identity law
 def test_zero_adjustment_staircase_and_matching():
     from tpu_gossip.core.matching_topology import matching_powerlaw_graph
     from tpu_gossip.kernels.pallas_segment import build_staircase_plan
@@ -189,6 +194,8 @@ def test_controller_widens_under_loss():
     assert np.asarray(s1.control_fanout).max() == 5
 
 
+@pytest.mark.slow  # refresh coverage stays in tier-1 via the controlled
+# dist parity (refresh_every=3); the credit book rides the slow lane
 def test_peerswap_refresh_preserves_credit_invariant():
     """PeerSwap swaps fire on cadence and the re-wiring plane's
     book-balance invariant — sum(degree_credit) == stored fresh targets
@@ -241,7 +248,10 @@ def test_control_cursor_checkpoint_roundtrip(tmp_path):
 # --------------------------------------------- local vs sharded identity
 
 
-@pytest.mark.parametrize("mode", ["push", "push_pull"])
+@pytest.mark.parametrize(
+    "mode",
+    [pytest.param("push", marks=pytest.mark.slow), "push_pull"],
+)  # push_pull (the richer lane) is the tier-1 controlled-dist witness
 def test_controlled_matching_dist_bit_identical(mode):
     """Active bounds + PeerSwap + needy pulls: the controlled matching
     round stays BIT-IDENTICAL local vs sharded (the adaptive extension
@@ -276,6 +286,8 @@ def test_controlled_matching_dist_bit_identical(mode):
             assert np.array_equal(a, np.asarray(getattr(ss, f))), f
 
 
+@pytest.mark.slow  # the composed matrix is the longest control case; the
+# single-feature dist parity above stands in for it in tier-1
 def test_controlled_composed_matrix_bit_identical():
     """scenario × growth × stream × control, local vs sharded matching:
     the FULL composition keeps the bit-identity contract."""
@@ -338,6 +350,8 @@ def test_controlled_composed_matrix_bit_identical():
             assert np.array_equal(a, np.asarray(getattr(ss, f))), f
 
 
+@pytest.mark.slow  # bucketed variant of the off-switch law held in tier-1
+# by the matching zero-adjustment test
 def test_controlled_bucketed_zero_adjust_and_runs():
     """The bucketed engine: zero-adjustment reproduces its own
     uncontrolled run bit for bit; active control completes and narrows."""
@@ -429,6 +443,8 @@ def _run_catalogue_entry(path, *, seed=0):
                                 coverage_target=0.95)
 
 
+@pytest.mark.slow  # sweeps the whole scenario catalogue; tier-1 keeps the
+# single-scenario reliability checks
 def test_reliability_contract_holds_across_catalogue():
     """THE acceptance sweep: a controlled loaded run holds the declared
     delivery-ratio target on EVERY scenario in scenarios/ (the catalogue

@@ -349,15 +349,19 @@ def _matching_state(g, cfg, seed=3, origins=(0, 5)):
         ("flood", {}),
         ("push", {}),
         ("push_pull", {}),
-        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
-                           rewire_slots=2)),
-        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
-                           rewire_slots=2, rewire_compact_cap=64)),
+        pytest.param("push_pull",
+                     dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                          rewire_slots=2), marks=pytest.mark.slow),
+        pytest.param("push_pull",
+                     dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                          rewire_slots=2, rewire_compact_cap=64),
+                     marks=pytest.mark.slow),
         ("push_pull", dict(sir_recover_rounds=2)),
         # forward_once is the only config taking the answer-bitmap branch
         # (a second expand+pipeline pass per word group inside shard_map)
         ("push_pull", dict(forward_once=True)),
-    ],
+    ],  # the churn twins are the dear rows; the scenario-parity flood case
+    # and the sparse push_pull case keep churny dist rounds in tier-1
     ids=["flood", "push", "push_pull", "push_pull_churn",
          "push_pull_churn_compact", "push_pull_sir", "push_pull_fwd_once"],
 )
@@ -524,13 +528,14 @@ def _chaos_spec(heal=4):
 @pytest.mark.parametrize(
     "mode,extra",
     [
-        ("push_pull", {}),
-        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
-                           rewire_slots=2)),
+        pytest.param("push_pull", {}, marks=pytest.mark.slow),
+        pytest.param("push_pull",
+                     dict(churn_leave_prob=0.02, churn_join_prob=0.2,
+                          rewire_slots=2), marks=pytest.mark.slow),
         ("flood", {}),
     ],
     ids=["push_pull", "push_pull_churn", "flood"],
-)
+)  # one scenario-parity witness in tier-1; the dearer modes ride slow
 def test_matching_dist_scenario_bit_identical(matching_setup, mode, extra):
     """THE acceptance criterion: a mesh round under an active scenario
     (loss + delay + partition + churn burst + blackout) is bit-identical
@@ -599,6 +604,8 @@ def test_bucketed_scenario_flood_parity_with_single_device(setup):
         )
 
 
+@pytest.mark.slow  # dist x adversary composition; solo adversary invariants
+# and plain dist parity each keep their law in tier-1
 def test_matching_dist_adversary_bit_identical(matching_setup):
     """The ADVERSARIAL extension of the bit-identity contract: a mesh
     round under Byzantine accusers + forgers + floods (composed with a
@@ -661,6 +668,8 @@ def test_matching_dist_adversary_bit_identical(matching_setup):
     assert int(np.asarray(stats_l.evictions_new).sum()) > 0
 
 
+@pytest.mark.slow  # composed variant; the plain adversary-parity test
+# above is the tier-1 witness
 def test_matching_dist_adversary_composed_bit_identical(matching_setup):
     """The composed cell: adversary × chaos scenario × stream × control ×
     pipeline on the mesh vs local — the whole adversarial round (attack

@@ -207,6 +207,8 @@ def test_control_bound_samples_are_integral():
     np.testing.assert_array_equal(v, np.rint(v))
 
 
+@pytest.mark.slow  # error-path composition over a full campaign run; lane
+# parity keeps the fleet contract in tier-1
 def test_consumed_campaign_refuses_lane_extraction(tmp_path):
     camp = _composed_campaign(tmp_path, seeds=4)
     fleet.run_campaign(camp, keep_states=False)

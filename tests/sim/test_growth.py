@@ -140,7 +140,10 @@ def test_growth_attachment_is_degree_preferential():
     )
 
 
-@pytest.mark.parametrize("shape", ["empty", "exhausted"])
+@pytest.mark.parametrize(
+    "shape",
+    ["empty", pytest.param("exhausted", marks=pytest.mark.slow)],
+)  # one zero-join witness in tier-1; the exhausted twin rides slow
 def test_zero_join_growth_is_bit_identical_to_fixed_n(shape):
     """THE determinism rail: a growth schedule with nothing to admit —
     zero-total or already exhausted — must reproduce the growth=None
@@ -189,12 +192,14 @@ def matching_growth_setup():
 @pytest.mark.parametrize(
     "mode,extra",
     [
-        ("push_pull", {}),
-        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2)),
+        pytest.param("push_pull", {}, marks=pytest.mark.slow),
+        pytest.param("push_pull",
+                     dict(churn_leave_prob=0.02, churn_join_prob=0.2),
+                     marks=pytest.mark.slow),
         ("flood", {}),
     ],
     ids=["push_pull", "push_pull_churn", "flood"],
-)
+)  # one growing-run parity witness in tier-1; dearer modes ride slow
 def test_matching_growth_local_vs_sharded_bit_identical(
     matching_growth_setup, mode, extra
 ):

@@ -66,6 +66,8 @@ def _assert_stats_equal(a, b, where=""):
 
 
 # ----------------------------------------------------- local composed matrix
+@pytest.mark.slow  # the maximal composed cell; the dist composed packed
+# parity below keeps the packed-plane law in tier-1
 def test_packed_simulate_bit_identical_maximal_cell():
     """Packed vs unpacked `simulate` on ONE maximal composed cell —
     chaos faults (loss + delay + blackout) AND Byzantine attacks in the
@@ -144,6 +146,8 @@ def mesh_fixture():
     )
 
 
+@pytest.mark.slow  # composed dist cell; CI builder-smoke runs this file
+# unfiltered, and the plain packed parity tests stay in tier-1
 def test_packed_dist_matching_bit_identical_composed(mesh_fixture):
     """Packed vs unpacked `simulate_dist` on the matching mesh with
     scenario + stream + pipeline composed — the packed carry keeps the

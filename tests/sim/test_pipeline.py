@@ -149,12 +149,12 @@ def _matching_planes(plan, composed: bool):
         ("flood", {}, False),
         ("push", {}, False),
         ("push_pull", {}, False),
-        ("push_pull", dict(rewire_slots=ATTACH, **{
+        pytest.param("push_pull", dict(rewire_slots=ATTACH, **{
             k: v for k, v in _CHURN.items() if k != "rewire_slots"
-        }), True),
+        }), True, marks=pytest.mark.slow),
     ],
     ids=["flood", "push", "push_pull", "composed"],
-)
+)  # the composed cell is the long pole; plain modes carry tier-1
 def test_matching_depth0_bit_identical_to_serial(
     matching_setup, mode, extra, composed
 ):
@@ -182,9 +182,10 @@ def test_matching_depth0_bit_identical_to_serial(
 
 @pytest.mark.parametrize(
     "mode,composed",
-    [("push", False), ("push_pull", False), ("push_pull", True)],
+    [("push", False), ("push_pull", False),
+     pytest.param("push_pull", True, marks=pytest.mark.slow)],
     ids=["push", "push_pull", "composed"],
-)
+)  # as above: composed cell slow, plain modes carry tier-1
 def test_bucketed_depth0_bit_identical_to_serial(
     bucketed_setup, mode, composed
 ):

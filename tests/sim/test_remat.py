@@ -251,6 +251,8 @@ def test_remat_identity_when_nothing_rewired(mode):
             np.sort(a[rp[i]:rp[i+1]]), np.sort(b[rp[i]:rp[i+1]]), err_msg=str(i)
         )
 
+@pytest.mark.slow  # end-to-end CLI epoch loop; the direct remat/repartition
+# parity tests above keep the law in tier-1
 def test_cli_shard_epoch_loop_runs_churn_remat_repartition():
     """VERDICT r4 item 3: the full churn -> remat -> repartition -> continue
     epoch loop through the CLI path, on the 8-device CPU mesh, both receive

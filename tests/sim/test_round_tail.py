@@ -135,6 +135,8 @@ def test_tail_bit_identity_matching_engine(matching, mode, extra):
         _assert_identical(outs["fused"][0], outs[tail][0], f"matching/{tail}")
 
 
+@pytest.mark.slow  # loop-composed variant; the per-engine MODE_GRID
+# bit-identity tests above keep the tail oracle in tier-1
 def test_tail_variants_identical_through_jitted_loops(pa_graph):
     """The tail choice rides simulate/run_until_coverage as a static arg:
     every implementation must yield the same trajectory AND the same

@@ -299,16 +299,21 @@ def test_bucketed_gate_falls_back_when_dense(setup):
 
 
 # ------------------------------------------------- matching engine parity
+# tier-1 keeps push_pull (both lanes live) as the parity witness; the
+# other modes assert the same law and ride the slow lane
 @pytest.mark.parametrize(
     "mode,extra",
     [
-        ("flood", {}),
-        ("push", {}),
+        pytest.param("flood", {}, marks=pytest.mark.slow),
+        pytest.param("push", {}, marks=pytest.mark.slow),
         ("push_pull", {}),
-        ("push_pull", dict(forward_once=True)),
-        ("push_pull", dict(sir_recover_rounds=2)),
-        ("push_pull", dict(churn_leave_prob=0.02, churn_join_prob=0.2,
-                           rewire_slots=2)),
+        pytest.param("push_pull", dict(forward_once=True),
+                     marks=pytest.mark.slow),
+        pytest.param("push_pull", dict(sir_recover_rounds=2),
+                     marks=pytest.mark.slow),
+        pytest.param("push_pull", dict(churn_leave_prob=0.02,
+                                       churn_join_prob=0.2, rewire_slots=2),
+                     marks=pytest.mark.slow),
     ],
     ids=["flood", "push", "push_pull", "push_pull_fwd_once", "push_pull_sir",
          "push_pull_churn"],
@@ -333,6 +338,8 @@ def test_matching_sparse_bit_identical_to_local(matching_setup, mode, extra):
     assert int(np.asarray(ici.sparse_lanes)[0]) > 0
 
 
+@pytest.mark.slow  # scenario composition of the parity law held in tier-1
+# by the push_pull case
 def test_matching_sparse_scenario_bit_identical(matching_setup):
     """Every fault class + sparse transport vs the local engine."""
     from tests.sim.test_dist import _chaos_spec
@@ -360,6 +367,8 @@ def test_matching_sparse_scenario_bit_identical(matching_setup):
     assert np.asarray(stats_d.msgs_dropped).sum() > 0
 
 
+@pytest.mark.slow  # growth composition of the parity law held in tier-1
+# by the push_pull case
 def test_matching_sparse_growing_bit_identical():
     """A GROWING sparse mesh run (the tests/sim/test_dist.py PR 4/5
     pattern): admissions ride advance_round outside the transport, so the
@@ -392,6 +401,8 @@ def test_matching_sparse_growing_bit_identical():
 
 
 # --------------------------------------------------------- ici accounting
+@pytest.mark.slow  # multi-round billing curve; the parity witness asserts
+# sparse_lanes > 0 so the tier-1 lane-activity guard remains
 def test_ici_counter_early_phase_reduction(matching_setup):
     """The analytic counter: early-phase shipped bytes must undercut dense
     by >= 3x (the ROADMAP success metric, tracked from this PR on), and

@@ -288,7 +288,10 @@ def _assert_states_equal(a, b):
         )
 
 
-@pytest.mark.parametrize("shape", ["none-vs-zero", "with-churn"])
+@pytest.mark.parametrize(
+    "shape",
+    ["none-vs-zero", pytest.param("with-churn", marks=pytest.mark.slow)],
+)  # one zero-rate witness in tier-1; the churn compose rides slow
 def test_zero_rate_stream_bit_identical_to_no_stream(shape):
     """THE determinism rail: a zero-rate stream must reproduce the fixed
     single-epidemic trajectory bit for bit — the injection stage draws
@@ -344,11 +347,12 @@ def _matching_rows(plan, ids):
 @pytest.mark.parametrize(
     "mode,law,compose",
     [
-        ("push_pull", "uniform", None),
+        pytest.param("push_pull", "uniform", None, marks=pytest.mark.slow),
         ("flood", "hotspot", None),
-        ("push_pull", "uniform", "scenario"),
+        pytest.param("push_pull", "uniform", "scenario",
+                     marks=pytest.mark.slow),
         ("push_pull", "uniform", "growth"),
-    ],
+    ],  # two loaded-run parity witnesses in tier-1, two on the slow lane
     ids=["push_pull", "flood_hotspot", "chaos_scenario", "flash_crowd"],
 )
 def test_matching_stream_local_vs_sharded_bit_identical(

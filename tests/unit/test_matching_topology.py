@@ -6,6 +6,7 @@ twins where sampled)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_gossip.core.matching_topology import (
     MatchingPlan,
@@ -181,6 +182,8 @@ def test_sampled_delivery_statistics():
     assert 0.3 * len(senders) < float(msgs) < 3.0 * len(senders)
 
 
+@pytest.mark.slow  # statistical twin sweep; the structural pairing tests
+# keep the matching topology in tier-1
 def test_push_pull_reaches_coverage_like_csr_twin():
     """Statistical twin: rounds-to-90% on the matching graph vs the XLA
     exactly-k path on the EXPORTED CSR are within a couple of rounds."""
@@ -248,6 +251,8 @@ def test_receptive_rows_gate():
     assert not bool(jnp.any(inc))
 
 
+@pytest.mark.slow  # model-statistics sweep; quantile-degree and involution
+# invariants pin the generator in tier-1
 def test_degree_correlation_near_neutral():
     """Configuration models are degree-uncorrelated; the structured pairing
     must not introduce assortativity (|r| small)."""
@@ -260,6 +265,8 @@ def test_degree_correlation_near_neutral():
     assert abs(r) < 0.1
 
 
+@pytest.mark.slow  # rebind-vs-rebuild twin; plan-class algebra tests keep
+# the rebind law in tier-1
 def test_with_fanout_rebind_matches_build():
     _, plan1 = _small_plan(n=2000, fanout=1, key=9)
     _, plan3 = matching_powerlaw_graph(
@@ -278,6 +285,8 @@ def test_with_fanout_rebind_matches_build():
     )
 
 
+@pytest.mark.slow  # SIR + churn epidemics at n=2500; the sim suite's
+# matching-mode parity tests cover the same delivery path
 def test_engine_modes_on_matching_plan():
     """SIR recovery and Poisson churn + re-wiring run through the matching
     delivery path (the engine's advance_round is delivery-agnostic)."""
@@ -310,6 +319,8 @@ def test_engine_modes_on_matching_plan():
     assert bool(jnp.any(fin2.rewired))
 
 
+@pytest.mark.slow  # scales the stage count up to large n; the involution
+# invariant below pins pairing correctness in tier-1
 def test_pairing_reach_spans_all_rows():
     """Regression for the 10M banding bug: with too few transpose stages,
     pairs can only form within ~128^K rows, turning the swarm into a 1-D
@@ -394,6 +405,8 @@ def test_fold_planes_matches_numpy():
     )
 
 
+@pytest.mark.slow  # structural audit of the sharded build; the sim dist-
+# builder bit-identity tests keep the sharded path in tier-1
 def test_sharded_builder_structure():
     """matching_powerlaw_graph_sharded: identical per-shard blocks, pad
     rows dead, CSR consistent with the plan's valid set, and the pairing a

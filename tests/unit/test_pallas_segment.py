@@ -244,6 +244,8 @@ def test_sampled_pull_requires_thresholds():
         segment_sampled(plan, transmit, transmit, 4, jax.random.key(0))
 
 
+@pytest.mark.slow  # 7-seed statistical curve sweep; the single-round
+# semantic parity tests keep the sampled kernel in tier-1
 def test_engine_sampled_kernel_curves_match_xla_path():
     """Statistical parity (VERDICT r2 item 2): the kernel's Bernoulli-per-edge
     push_pull and the XLA exactly-k path must produce the same coverage
@@ -381,6 +383,8 @@ def test_engine_churn_kernel_isolated_rewired_rows_untouched():
     assert not seen[~rw_mask, 3].any(), "rewired sender's words leaked via kernel"
 
 
+@pytest.mark.slow  # multi-seed curve sweep; stale/fresh semantics and row
+# gating keep the churn kernel in tier-1
 def test_engine_churn_kernel_curves_match_xla_path():
     """Statistical parity for BASELINE config 5 on the kernel path: Poisson
     churn + power-law re-wiring must show the same coverage dynamics through

@@ -2,10 +2,13 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from tpu_gossip.utils.profiling import trace
 
 
+@pytest.mark.slow  # spins up the real xplane writer; the no-op contract
+# below keeps the trace hook in tier-1
 def test_trace_writes_profile_artifacts(tmp_path):
     log_dir = tmp_path / "trace"
     with trace(log_dir):
@@ -36,6 +39,8 @@ def test_slope_time_measures_positive_per_iteration_cost():
     assert dt == dt and dt > 0  # finite, positive
 
 
+@pytest.mark.slow  # slope-timing every stage is wall-heavy; the CLI test
+# below drives the same decomposition and stays in tier-1
 def test_profile_round_stages_covers_every_stage():
     """The stage decomposition (run_sim --profile-round): every declared
     stage present, tails selectable, values floats (NaN allowed at toy
@@ -72,6 +77,7 @@ def test_profile_round_stages_covers_every_stage():
     assert "| stage | ms/round |" in table and "tail[fused]" in table
 
 
+@pytest.mark.slow  # composed-planes variant of the stage decomposition
 def test_profile_round_stages_composed_planes():
     """PR 10 satellite: the decomposition covers the post-PR-3 stages —
     growth / stream / control rows appear when compiled planes are
@@ -111,6 +117,8 @@ def test_profile_round_stages_composed_planes():
     assert all(isinstance(v, float) for v in stages.values())
 
 
+@pytest.mark.slow  # planes-composed CLI variant; the plain CLI profile
+# test remains the tier-1 witness
 def test_run_sim_profile_round_cli_composes_with_planes(capsys):
     """run_sim --profile-round with --grow/--stream/--control runs the
     composed decomposition (the old parse-time rejections are gone) and
@@ -130,6 +138,8 @@ def test_run_sim_profile_round_cli_composes_with_planes(capsys):
         assert k in row["stages_ms"], k
 
 
+@pytest.mark.slow  # full profile table over a real run; slope_time and the
+# no-op trace contract keep the profiling util in tier-1
 def test_run_sim_profile_round_cli(capsys):
     import json
 

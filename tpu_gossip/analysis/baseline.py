@@ -27,7 +27,12 @@ from pathlib import Path
 
 from tpu_gossip.analysis.registry import Finding
 
-__all__ = ["load_baseline", "write_baseline", "split_new"]
+__all__ = [
+    "load_baseline",
+    "load_baseline_entries",
+    "write_baseline",
+    "split_new",
+]
 
 DEFAULT_BASELINE = "lint_baseline.toml"
 
@@ -90,7 +95,54 @@ def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
     return entries
 
 
+def load_baseline_entries(path: str | Path) -> list[Finding]:
+    """The baseline's entries as ordered :class:`Finding` stubs — every
+    serialized column restored (col is not serialized and reloads as 0).
+    :func:`write_baseline` of this list reproduces the file byte-for-byte
+    (the write→load→write fixed point tests/analysis/test_baseline.py
+    pins), so regenerated baselines diff cleanly against committed ones.
+    """
+    p = Path(path)
+    if not p.is_file():
+        return []
+    entries: list[Finding] = []
+    cur: dict[str, str] | None = None
+
+    def flush():
+        if cur is None or "file" not in cur or "rule" not in cur:
+            return
+        try:
+            line = int(cur.get("line", "0"))
+        except ValueError:
+            line = 0
+        entries.append(Finding(
+            file=cur["file"], line=line, col=0, rule=cur["rule"],
+            message=cur.get("message", ""),
+            qualname=cur.get("qualname") or None,
+        ))
+
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[finding]]":
+            flush()
+            cur = {}
+        elif "=" in line:
+            key, _, value = line.partition("=")
+            if cur is not None:
+                cur[key.strip()] = _unquote(value)
+    flush()
+    return entries
+
+
 def write_baseline(path: str | Path, findings: list[Finding]) -> None:
+    """Serialize ``findings`` deterministically: entries sorted by
+    (rule, file, line, qualname, message) — every sort column IS a
+    serialized column, which is what makes write→load→write a fixed
+    point and regenerated baselines diff cleanly. ``line`` and
+    ``message`` are informational columns only; identity stays
+    (file, rule, qualname) — see :func:`load_baseline`."""
     lines = [
         "# graftlint baseline — pre-existing findings suppressed from the",
         "# exit code. Prefer inline `# graftlint: disable=<rule> -- reason`",
@@ -100,7 +152,11 @@ def write_baseline(path: str | Path, findings: list[Finding]) -> None:
         "version = 1",
     ]
     seen = set()
-    for f in sorted(findings, key=lambda f: f.baseline_key):
+    order = sorted(
+        findings,
+        key=lambda f: (f.rule, f.file, f.line, f.qualname or "", f.message),
+    )
+    for f in order:
         if f.baseline_key in seen:
             continue
         seen.add(f.baseline_key)
@@ -108,18 +164,12 @@ def write_baseline(path: str | Path, findings: list[Finding]) -> None:
             "",
             "[[finding]]",
             f"file = {_quote(f.file)}",
+            f"line = {int(f.line)}",
             f"rule = {_quote(f.rule)}",
         ]
-        # qualname is the identity when present (the message rides along
-        # as a comment for the human reader — the loader skips it);
-        # legacy message form otherwise
         if f.qualname:
             lines.append(f"qualname = {_quote(f.qualname)}")
-            first = f.message.splitlines()[0] if f.message else ""
-            if first:
-                lines.append(f"# message: {first}")
-        else:
-            lines.append(f"message = {_quote(f.message)}")
+        lines.append(f"message = {_quote(f.message)}")
     Path(path).write_text("\n".join(lines) + "\n")
 
 

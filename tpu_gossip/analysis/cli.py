@@ -267,6 +267,29 @@ def main(argv: list[str] | None = None) -> int:
         "review surface)",
     )
     ap.add_argument(
+        "--collectives-lock", default=None,
+        help="collective lock file (default: <repo>/collectives.lock)",
+    )
+    ap.add_argument(
+        "--write-collectives-lock", action="store_true",
+        help="trace the matrix, write every mesh entry's collective "
+        "program (ordered ops + per-axis ici/dcn byte columns) to the "
+        "lock file and exit 0 (the committed diff is the review surface)",
+    )
+    ap.add_argument(
+        "--check-collectives-lock", action="store_true",
+        help="fail when any mesh entry's traced collective program "
+        "drifted from the committed lock file (deep-collective-lock-"
+        "drift findings; stale lock entries report but do not fail)",
+    )
+    ap.add_argument(
+        "--deep-selftest", action="store_true",
+        help="run the deep tier's adversarial self-test fixtures (a "
+        "deliberately divergent collective, a deliberate out-of-codec "
+        "unpack) and exit 0 iff both rules fire — the gate that keeps "
+        "the gate honest",
+    )
+    ap.add_argument(
         "--baseline", default=None,
         help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})",
     )
@@ -302,6 +325,23 @@ def main(argv: list[str] | None = None) -> int:
     if args.planes:
         return _print_planes(args)
 
+    if args.deep_selftest:
+        # the gate that keeps the gate honest: both adversarial fixtures
+        # (divergent collective, out-of-codec unpack) must still FIRE
+        _ensure_multi_device_env()
+        from tpu_gossip.analysis.deep.selftest import run_selftest
+
+        failures = run_selftest()
+        for msg in failures:
+            print(f"deep-selftest FAIL: {msg}", file=sys.stderr)
+        print(
+            "deep-selftest: "
+            + ("both adversarial fixtures fired"
+               if not failures else f"{len(failures)} dead rail(s)"),
+            file=sys.stderr,
+        )
+        return 1 if failures else 0
+
     root = repo_root()
     only = (
         [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -323,13 +363,38 @@ def main(argv: list[str] | None = None) -> int:
     # lint sources without importing the fixtures' runtime, so the
     # mem-only modes cannot run there — a silent no-op would exit 0
     # having analyzed NOTHING, which is worse than refusing
-    if (args.write_budget or args.mem_only) and explicit_paths:
+    if (args.write_budget or args.mem_only or args.write_collectives_lock
+            or args.check_collectives_lock) and explicit_paths:
         print(
-            "--mem-only/--write-budget trace the full entry-point matrix; "
+            "--mem-only/--write-budget/--write-collectives-lock/"
+            "--check-collectives-lock trace the full entry-point matrix; "
             "they cannot run with explicit paths",
             file=sys.stderr,
         )
         return 2
+    # --write-collectives-lock is a dedicated mode (pattern of
+    # --write-budget): only the trace + program extraction run, nothing
+    # the early exit could swallow
+    if args.write_collectives_lock:
+        _ensure_multi_device_env()
+        from tpu_gossip.analysis.deep.collectives import (
+            collective_report,
+            write_lock,
+        )
+        from tpu_gossip.analysis.entrypoints import entry_points, trace_matrix
+
+        traced = trace_matrix(entry_points(), cache={})
+        _, programs = collective_report(traced)
+        lock_path = (
+            Path(args.collectives_lock) if args.collectives_lock
+            else root / "collectives.lock"
+        )
+        write_lock(lock_path, programs)
+        print(
+            f"wrote {len(programs)} collective program(s) to {lock_path}",
+            file=sys.stderr,
+        )
+        return 0
     run_contracts = (
         (not args.no_contracts and not explicit_paths and only is None)
         or args.contracts_only
@@ -408,6 +473,42 @@ def main(argv: list[str] | None = None) -> int:
             return 0
         findings = findings + mem_findings
 
+    coll_report = None
+    if args.check_collectives_lock:
+        # lock freshness only: uniformity findings come from the deep
+        # tier itself (running both must not double-report), and stale
+        # lock entries (committed on a host where more of the matrix
+        # traced, e.g. the dist cells) report without failing
+        _ensure_multi_device_env()
+        from tpu_gossip.analysis.deep.collectives import (
+            collective_report,
+            load_lock,
+            lock_findings,
+        )
+        from tpu_gossip.analysis.entrypoints import entry_points, trace_matrix
+
+        traced = trace_matrix(entry_points(), cache=trace_cache)
+        _, programs = collective_report(traced)
+        lock_path = (
+            Path(args.collectives_lock) if args.collectives_lock
+            else root / "collectives.lock"
+        )
+        drift, stale = lock_findings(programs, load_lock(lock_path))
+        findings = findings + drift
+        if stale:
+            print(
+                f"collectives.lock: {len(stale)} stale entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (locked but not "
+                f"traced on this host): {', '.join(stale)}",
+                file=sys.stderr,
+            )
+        coll_report = {
+            "lock": str(lock_path),
+            "entries": sorted(programs),
+            "drift": len(drift),
+            "stale": stale,
+        }
+
     baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
     if args.write_baseline:
         write_baseline(baseline_path, findings)
@@ -447,6 +548,7 @@ def main(argv: list[str] | None = None) -> int:
                     # identity-stable-diff property as the findings order
                     "mem_report": mem_report,
                     "mem_seconds": mem_seconds,
+                    "collectives": coll_report,
                     "elapsed_seconds": round(elapsed, 2),
                 },
                 indent=1,

@@ -19,6 +19,15 @@ audit through a per-invocation cache), and applies three passes:
 - :mod:`.donation` (``deep-use-after-donate``) — traced ``pjit``
   equations donate every state leaf, and no caller reads a name it
   donated (``clone_state`` is the escape hatch).
+- :mod:`.collectives` (``deep-collective-uniformity``,
+  ``deep-collective-lock-drift``) — every shard_map body's collective
+  program is extracted (ordered ops, named axes, per-axis ici/dcn byte
+  columns) and held mesh-uniform: no collective under a shard-varying
+  branch unless every arm posts the identical sequence; the program is
+  pinned in the committed ``collectives.lock``.
+- :mod:`.liveness` (``deep-transient-liveness``) — source-line peak
+  attribution over the graftmem sweep, and the packed-codec rail:
+  packed storage words decode only inside ``core/packed.py``.
 
 Run: ``python -m tpu_gossip.analysis --deep`` (or ``--deep-only``).
 Findings flow through the same registry/baseline/CLI machinery as the
@@ -49,11 +58,13 @@ def run_deep(cache: dict | None = None, *, modules=None,
     entirely (explicit-path CLI runs lint sources only, the same reason
     the contract audit skips there).
     """
+    from tpu_gossip.analysis.deep.collectives import collective_report
     from tpu_gossip.analysis.deep.donation import (
         donation_ast_findings,
         donation_jaxpr_findings,
     )
     from tpu_gossip.analysis.deep.lineage import lineage_findings
+    from tpu_gossip.analysis.deep.liveness import liveness_findings
     from tpu_gossip.analysis.deep.reductions import reduction_findings
 
     findings: list[Finding] = []
@@ -75,6 +86,9 @@ def run_deep(cache: dict | None = None, *, modules=None,
         findings.extend(lineage_findings(traced))
         findings.extend(reduction_findings(traced))
         findings.extend(donation_jaxpr_findings(traced))
+        coll_findings, _ = collective_report(traced)
+        findings.extend(coll_findings)
+        findings.extend(liveness_findings(traced))
     findings.extend(
         donation_ast_findings(
             _scope_modules() if modules is None else modules

@@ -1,0 +1,234 @@
+"""Deep pass — transient-liveness attribution + the packed-codec rail.
+
+graftmem's ledger (analysis/mem/ledger.py) prices WHAT is resident at an
+entry's peak — plane names and ``intermediate:<prim>`` buckets. This
+pass answers WHERE: :func:`entry_liveness` runs the IDENTICAL live-range
+sweep (the same ``_analyze``, handed a source-line labeler) so its peak
+equals the ledger's byte-for-byte, but every intermediate is attributed
+to the repo line of the equation that materializes it (jaxpr
+source_info via :func:`~tpu_gossip.analysis.deep.jaxpr_tools.src_of`).
+For the packed entries this turns ROADMAP's "unpack spike" — the
+unpack→round→repack transient (142 B/peer live vs the 67 B/peer packed
+resident) — from a bench observation into a named ``file:line``: the
+``core/packed.py`` codec lines that materialize the full-width bool
+planes dominate the top of the breakdown.
+
+The rule (``deep-transient-liveness``) is the codec rail that keeps that
+spike CONTAINED: in a ``--packed`` entry, the packed storage words
+(the uint8 bit-planes named in ``core.packed.BIT_PLANES`` + the shared
+``flags`` word) may only be decoded inside the sanctioned codec in
+``core/packed.py``. A hand-rolled shift-and-mask decode anywhere else
+materializes a second full-width (N, M) bool plane the ledger's budget
+never priced — and silently forks the bit-order contract. Detection is
+a taint walk: entry state leaves that are packed words seed the taint;
+structural ops (reshape/slice/transpose/...) and control-flow
+boundaries propagate it; codec equations (source file
+``core/packed.py``) may consume it freely — their uint8 outputs are
+re-packed words (tainted), their bool outputs are sanctioned decoded
+planes (clean) — and any other equation consuming a tainted var is a
+finding. This is also the register where the ROADMAP's packed-native
+kernels will live: a sanctioned bit-wise kernel extends the codec file
+(or earns an explicit pragma), it does not silently decode.
+
+Docs: docs/static_analysis.md (deep-tier catalogue + "reading a
+transient-liveness finding"). Self-test fixture:
+analysis/deep/selftest.py (a deliberate out-of-codec unpack).
+"""
+
+from __future__ import annotations
+
+import re
+
+from tpu_gossip.analysis.registry import Finding
+
+__all__ = ["RULE", "entry_liveness", "liveness_findings", "codec_findings"]
+
+RULE = "deep-transient-liveness"
+
+# the one source file licensed to touch packed storage words
+_CODEC_FILE = "tpu_gossip/core/packed.py"
+
+# prims that move/reshape a buffer without computing on its bits: they
+# propagate the packed-words taint but are not themselves a decode
+_STRUCTURAL = frozenset({
+    "reshape", "transpose", "squeeze", "expand_dims", "broadcast_in_dim",
+    "slice", "dynamic_slice", "dynamic_update_slice", "rev", "copy",
+    "concatenate", "pad", "gather", "scatter", "convert_element_type",
+    "select_n", "stop_gradient",
+})
+
+_TOP_K = 8
+
+
+def _leaf_name(path) -> str:
+    """Pytree key path -> bare leaf name (".seen" / "['seen']" -> "seen")."""
+    import jax.tree_util as jtu
+
+    return re.sub(r"\W", "", jtu.keystr((path[-1],)) if path else "")
+
+
+def _line_label(eqn) -> str | None:
+    from tpu_gossip.analysis.deep.jaxpr_tools import src_of
+
+    src = src_of(eqn)
+    if src is None:
+        return None
+    return f"{src.file}:{src.line} ({src.function})"
+
+
+def entry_liveness(name: str, te) -> dict | None:
+    """Source-line residency of one TracedEntry (None if it didn't trace).
+
+    Returns ``{"peak_bytes", "top": [[label, bytes], ...]}`` — the same
+    live-range peak as :func:`analysis.mem.ledger.entry_ledger` (same
+    sweep, test-pinned equal), with intermediates labeled
+    ``file:line (function)`` instead of ``intermediate:<prim>``. State
+    invars label ``state:<leaf>``; const residency is excluded from the
+    peak exactly as the ledger excludes it.
+    """
+    if te.jaxpr is None:
+        return None
+    import jax.tree_util as jtu
+
+    from tpu_gossip.analysis.mem.ledger import _analyze
+
+    closed = te.jaxpr
+    labels: dict = {}
+    leaves = (
+        jtu.tree_flatten_with_path(te.state)[0]
+        if te.state is not None else []
+    )
+    for var, (path, _) in zip(closed.jaxpr.invars, leaves):
+        labels[var] = f"state:{jtu.keystr(path).lstrip('.')}"
+    for cv in closed.jaxpr.constvars:
+        labels[cv] = "const"
+    peak, breakdown = _analyze(closed.jaxpr, labels, _line_label)
+    peak -= breakdown.pop("const", 0)
+    top = sorted(breakdown.items(), key=lambda kv: (-kv[1], kv[0]))[:_TOP_K]
+    return {
+        "peak_bytes": int(peak),
+        "top": [[lbl, int(b)] for lbl, b in top],
+    }
+
+
+def _taint_seeds(te) -> set:
+    """Entry invars holding packed storage words: the uint8 state leaves
+    named in BIT_PLANES (+ the shared flags word)."""
+    import jax.tree_util as jtu
+    import numpy as np
+
+    from tpu_gossip.core.packed import BIT_PLANES
+
+    packed_names = set(BIT_PLANES) | {"flags"}
+    seeds = set()
+    leaves = (
+        jtu.tree_flatten_with_path(te.state)[0]
+        if te.state is not None else []
+    )
+    for var, (path, _) in zip(te.jaxpr.jaxpr.invars, leaves):
+        dtype = getattr(getattr(var, "aval", None), "dtype", None)
+        if _leaf_name(path) in packed_names and dtype == np.uint8:
+            seeds.add(var)
+    return seeds
+
+
+def codec_findings(name: str, te) -> list[Finding]:
+    """The packed-codec rail over one packed entry's trace."""
+    if te.jaxpr is None:
+        return []
+    import numpy as np
+    from jax._src import core
+
+    from tpu_gossip.analysis.deep.jaxpr_tools import src_of, subjaxprs
+    from tpu_gossip.analysis.mem.ledger import _boundary_maps
+
+    tainted = _taint_seeds(te)
+    if not tainted:
+        return []
+    findings: list[Finding] = []
+    seen_sites: set = set()
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            any_taint = any(
+                isinstance(a, core.Var) and a in tainted
+                for a in eqn.invars
+            )
+            subs = list(subjaxprs(eqn))
+            if subs:
+                # control-flow boundary: thread the taint through the
+                # positional maps (carries keep their identity), never a
+                # violation in itself
+                for pname, sub in subs:
+                    outer = _boundary_maps(eqn, sub, pname)
+                    if outer is not None:
+                        for sv, ov in zip(sub.invars, outer):
+                            if isinstance(ov, core.Var) and ov in tainted:
+                                tainted.add(sv)
+                    walk(sub)
+                    if len(sub.outvars) == len(eqn.outvars):
+                        for sv, ov in zip(sub.outvars, eqn.outvars):
+                            if isinstance(sv, core.Var) and sv in tainted:
+                                tainted.add(ov)
+                continue
+            src = src_of(eqn)
+            in_codec = src is not None and src.file == _CODEC_FILE
+            if in_codec:
+                # the sanctioned codec: uint8 outputs are (re)packed
+                # words — still storage; bool outputs are decoded planes
+                # — clean by license
+                if any_taint:
+                    for v in eqn.outvars:
+                        dt = getattr(getattr(v, "aval", None), "dtype", None)
+                        if dt == np.uint8:
+                            tainted.add(v)
+            elif prim in _STRUCTURAL:
+                if any_taint:
+                    tainted.update(
+                        v for v in eqn.outvars if isinstance(v, core.Var)
+                    )
+            elif any_taint:
+                site = (src.file, src.line, prim) if src else (None, 0, prim)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                out_shapes = ", ".join(
+                    f"{getattr(v.aval, 'dtype', '?')}"
+                    f"{list(getattr(v.aval, 'shape', ()))}"
+                    for v in eqn.outvars if hasattr(v, "aval")
+                )
+                findings.append(Finding(
+                    file=src.file if src else f"<deep:{name}>",
+                    line=src.line if src else 0,
+                    col=0,
+                    rule=RULE,
+                    message=(
+                        f"packed storage words consumed by `{prim}` "
+                        f"outside the sanctioned codec (-> {out_shapes}) "
+                        "— a hand-rolled decode materializes a second "
+                        "full-width plane the memory budget never "
+                        "priced, and forks the bit-order contract"
+                    ),
+                    hint="decode through core/packed.py "
+                    "(unpack_bits/unpack_flag/bit_column), or move the "
+                    "bit-wise kernel into the codec where the ledger "
+                    "prices its transient",
+                    qualname=(
+                        f"{name}:{src.function}" if src else name
+                    ),
+                ))
+        return
+
+    walk(te.jaxpr.jaxpr)
+    return findings
+
+
+def liveness_findings(traced) -> list[Finding]:
+    """The packed-codec rail over every packed entry of the matrix."""
+    findings: list[Finding] = []
+    for name in sorted(traced):
+        te = traced[name]
+        if te.ep is not None and getattr(te.ep, "packed", False):
+            findings.extend(codec_findings(name, te))
+    return findings

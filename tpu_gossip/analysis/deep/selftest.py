@@ -1,0 +1,139 @@
+"""Adversarial self-test fixtures for the two wire-safety deep passes.
+
+A static gate that silently stops firing is worse than no gate: CI would
+keep passing while the rail it trusts has rotted. This module builds two
+DELIBERATELY broken synthetic entries — never part of the real matrix —
+and asserts the passes still report them:
+
+- :func:`divergent_collective_entry` — a ``shard_map`` body that issues
+  a ``psum`` in ONE arm of a ``lax.cond`` gated on a shard-varying
+  predicate (the local shard's own data). Bit-for-bit the deadlock shape
+  ``deep-collective-uniformity`` exists for; jax traces it without
+  complaint, which is the point.
+- :func:`unpack_spike_entry` — a packed entry whose trace hand-rolls the
+  LSB-first shift-and-mask decode OUTSIDE ``core/packed.py``,
+  materializing a full-width (N, M) bool plane the budget never priced.
+  ``deep-transient-liveness`` must name this file's decode line.
+
+:func:`run_selftest` runs both and returns the failures (empty = the
+rails fire). CI runs it as a step of the lint-deep job
+(``python -m tpu_gossip.analysis --deep-selftest``); the same fixtures
+back tests/analysis/test_collectives.py / test_liveness.py.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "divergent_collective_entry",
+    "unpack_spike_entry",
+    "run_selftest",
+]
+
+_N_FIXTURE = 32  # tiny synthetic swarm rows (fast to trace, full-width)
+_M_FIXTURE = 16  # slot width: packs to 2 uint8 words per row
+
+
+def _entry(name: str, fn, state, *, packed: bool = False):
+    """A synthetic TracedEntry outside the real matrix (selftest only)."""
+    import jax
+
+    from tpu_gossip.analysis.entrypoints import EntryPoint, TracedEntry
+
+    ep = EntryPoint(
+        name=name, engine="selftest", kind="round",
+        audit_check="selftest", build=lambda: (fn, state),
+        n_peers=_N_FIXTURE, packed=packed,
+    )
+    te = TracedEntry(ep=ep, state=state)
+    te.jaxpr, te.out_shape = jax.make_jaxpr(fn, return_shape=True)(state)
+    return name, te
+
+
+def divergent_collective_entry():
+    """(name, TracedEntry): a collective under a shard-varying branch."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_gossip.dist._compat import shard_map_compat
+    from tpu_gossip.dist.mesh import AXIS, make_mesh
+
+    mesh = make_mesh()
+
+    def body(x):
+        # the predicate reads the SHARD'S OWN slice: shard-varying, so
+        # the arms below rendezvous on some shards and not others
+        pred = x[0] > 0.0
+        return jax.lax.cond(
+            pred,
+            lambda v: jax.lax.psum(v, AXIS),
+            lambda v: v,
+            x,
+        )
+
+    fn = shard_map_compat(
+        body, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)
+    )
+    state = jnp.arange(float(mesh.size * 4)).reshape(mesh.size * 4)
+    return _entry("selftest[divergent-collective]", fn, state)
+
+
+def unpack_spike_entry():
+    """(name, TracedEntry): a hand-rolled decode outside the codec."""
+    import jax.numpy as jnp
+
+    from tpu_gossip.core.packed import pack_bits
+
+    words = pack_bits(
+        (jnp.arange(_N_FIXTURE * _M_FIXTURE) % 3 == 0).reshape(
+            _N_FIXTURE, _M_FIXTURE
+        )
+    )
+
+    def rogue(state):
+        w = state["seen"]
+        # the forbidden shape: shift-and-mask decode of packed words in
+        # THIS file, not core/packed.py — a second (N, M) bool plane
+        bits = (w[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+        plane = bits.reshape(w.shape[0], -1)[:, :_M_FIXTURE] != 0
+        return plane.sum()
+
+    return _entry(
+        "selftest[unpack-spike]", rogue, {"seen": words}, packed=True
+    )
+
+
+def run_selftest() -> list[str]:
+    """Run both adversarial fixtures; returns failure descriptions
+    (empty = both rails fire)."""
+    from tpu_gossip.analysis.deep.collectives import RULE as COLL_RULE
+    from tpu_gossip.analysis.deep.collectives import entry_program
+    from tpu_gossip.analysis.deep.liveness import RULE as LIVE_RULE
+    from tpu_gossip.analysis.deep.liveness import codec_findings
+
+    failures: list[str] = []
+
+    name, te = divergent_collective_entry()
+    ops, findings = entry_program(name, te)
+    if not ops:
+        failures.append(
+            f"{name}: extracted an EMPTY collective program (the psum "
+            "under the cond arm was not seen)"
+        )
+    if not any(f.rule == COLL_RULE and "diverges" in f.message
+               for f in findings):
+        failures.append(
+            f"{name}: {COLL_RULE} did not fire on a collective under a "
+            "shard-varying branch arm"
+        )
+
+    name, te = unpack_spike_entry()
+    findings = codec_findings(name, te)
+    if not any(
+        f.rule == LIVE_RULE and f.file.endswith("selftest.py")
+        for f in findings
+    ):
+        failures.append(
+            f"{name}: {LIVE_RULE} did not fire on an out-of-codec decode"
+        )
+    return failures

@@ -70,6 +70,10 @@ class EntryPoint:
     # dim, test-pinned) — the mem tier's bytes/peer denominator; 0 would
     # mean a matrix entry whose scale nobody declared, which cannot exist
     n_peers: int = 0
+    # the traced state is a PackedSwarm (core/packed.py): the deep
+    # transient-liveness pass holds these entries to the codec contract —
+    # packed words may only be decoded inside core/packed.py
+    packed: bool = False
 
 
 @dataclasses.dataclass
@@ -604,7 +608,7 @@ def _local_entries() -> list[EntryPoint]:
         name="local[simulate,packed]", engine="xla", kind="simulate",
         audit_check="simulate_and_coverage", build=build_sim_packed,
         stats_leading=(_SIM_ROUNDS,), jit_name="simulate",
-        n_peers=ctx["dg"].n_pad,
+        n_peers=ctx["dg"].n_pad, packed=True,
     ))
 
     def build_cov_packed():
@@ -621,6 +625,7 @@ def _local_entries() -> list[EntryPoint]:
         kind="coverage", audit_check="simulate_and_coverage",
         build=build_cov_packed, stats_leading=None,
         jit_name="run_until_coverage", n_peers=ctx["dg"].n_pad,
+        packed=True,
     ))
 
     # the BATCHED fleet entry (fleet/): a composed scenario×stream×
@@ -851,7 +856,7 @@ def _dist_entries() -> list[EntryPoint]:
         name="dist[matching,simulate,packed]", engine="dist-matching",
         kind="simulate", audit_check="gossip_round_dist",
         build=build_dist_sim_packed, stats_leading=(_DIST_SIM_ROUNDS,),
-        jit_name="simulate_dist", n_peers=plan.n,
+        jit_name="simulate_dist", n_peers=plan.n, packed=True,
     ))
     eps.append(dist_ep(
         "dist[bucketed,run_until_coverage]", "dist-bucketed",

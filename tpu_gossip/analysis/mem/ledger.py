@@ -125,11 +125,15 @@ def _carry_credit(eqn, sizes) -> int:
     return 0
 
 
-def _analyze(jaxpr, labels):
+def _analyze(jaxpr, labels, label_of=None):
     """(peak_bytes, breakdown{label: bytes}) for one (open) jaxpr.
 
     ``labels`` maps this jaxpr's vars to attribution labels; vars absent
-    from it are labeled from their defining equation.
+    from it are labeled from their defining equation — by default the
+    ``intermediate:<prim>`` bucket, or through ``label_of(eqn) -> str |
+    None`` when a caller supplies one (the deep transient-liveness pass
+    labels by source line over the IDENTICAL sweep, so its peaks equal
+    this ledger's by construction).
     """
     from jax._src import core
 
@@ -156,7 +160,8 @@ def _analyze(jaxpr, labels):
         for v in eqn.outvars:
             def_idx[v] = i
             last_use[v] = i
-            labels.setdefault(v, f"intermediate:{eqn.primitive.name}")
+            lbl = label_of(eqn) if label_of is not None else None
+            labels.setdefault(v, lbl or f"intermediate:{eqn.primitive.name}")
     for a in jaxpr.outvars:
         if is_var(a) and a in def_idx:
             last_use[a] = k
@@ -183,7 +188,7 @@ def _analyze(jaxpr, labels):
                 for sv, ov in zip(sub.invars, outer):
                     if is_var(ov) and ov in labels:
                         sub_labels[sv] = labels[ov]
-            sub_peak, sub_break = _analyze(sub, sub_labels)
+            sub_peak, sub_break = _analyze(sub, sub_labels, label_of)
             boundary = sum(aval_bytes(v.aval) for v in sub.invars)
             boundary += sum(
                 aval_bytes(a.aval) for a in sub.outvars if is_var(a)

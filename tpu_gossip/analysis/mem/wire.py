@@ -35,8 +35,12 @@ _WIRE_ENTRIES = {
     "dist[matching]": "matching",
 }
 
+# psum2/pmax2/pmin2 are the check_rep-era spellings jax traces for the
+# same wire ops — censused under their base name so the report columns
+# stay stable across jax versions
 _COLLECTIVES = ("all_to_all", "psum", "pmax", "pmin", "ppermute",
                 "all_gather")
+_PRIM_ALIASES = {"psum2": "psum", "pmax2": "pmax", "pmin2": "pmin"}
 
 
 def _aval_words(aval) -> int:
@@ -49,13 +53,22 @@ def _aval_words(aval) -> int:
 
 
 def collective_census(te, n_shards: int) -> dict:
-    """Per-primitive global shipped words of one entry's trace."""
-    from tpu_gossip.analysis.deep.jaxpr_tools import iter_eqns
+    """Per-primitive global shipped words of one entry's trace.
 
-    census = {k: 0 for k in _COLLECTIVES}
+    The special ``per_axis`` row splits the same global volume into
+    BYTE columns keyed by interconnect class (``dist.mesh.axis_kind``:
+    ici vs dcn) — the static metric split the multi-host transport work
+    budgets against (mirrors the columns of ``collectives.lock``).
+    """
+    from tpu_gossip.analysis.deep.collectives import _axes_of
+    from tpu_gossip.analysis.deep.jaxpr_tools import iter_eqns
+    from tpu_gossip.dist.mesh import axis_kind
+
+    census: dict = {k: 0 for k in _COLLECTIVES}
+    per_axis: dict = {}
     for eqn, inside in iter_eqns(te.jaxpr.jaxpr):
-        prim = eqn.primitive.name
-        if prim not in census:
+        prim = _PRIM_ALIASES.get(eqn.primitive.name, eqn.primitive.name)
+        if prim not in _COLLECTIVES:
             continue
         # each of the S shards ships its (per-shard-shaped) operand; the
         # global wire is S x the block (psum/pmax reductions move the
@@ -64,7 +77,13 @@ def collective_census(te, n_shards: int) -> dict:
             _aval_words(a.aval) for a in eqn.invars if hasattr(a, "aval")
         )
         census[prim] += n_shards * words
-    return {k: v for k, v in census.items() if v}
+        for ax in _axes_of(eqn):
+            kind = axis_kind(ax)
+            per_axis[kind] = per_axis.get(kind, 0) + n_shards * words * 4
+    out = {k: v for k, v in census.items() if v}
+    if per_axis:
+        out["per_axis"] = dict(sorted(per_axis.items()))
+    return out
 
 
 def wire_findings(traced) -> tuple[list, dict]:

@@ -89,6 +89,9 @@ DEEP_RULES = frozenset({
     "deep-float-reduction",
     "deep-use-after-donate",
     "deep-trace-error",
+    "deep-collective-uniformity",
+    "deep-collective-lock-drift",
+    "deep-transient-liveness",
 })
 
 # rule ids owned by the jaxpr memory tier (analysis/mem/) — like the deep

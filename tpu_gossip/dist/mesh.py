@@ -65,9 +65,25 @@ __all__ = [
     "simulate_dist",
     "run_until_coverage_dist",
     "dense_wire_words",
+    "AXIS_KINDS",
+    "axis_kind",
 ]
 
 AXIS = "peers"
+
+# mesh axis -> interconnect class. The planned multi-host topology is a
+# 2-level mesh: the per-host shard axis rides ICI, a future "hosts" axis
+# rides DCN. The static wire analyses (analysis/deep/collectives.py,
+# analysis/mem/wire.py) split their per-collective byte columns with this
+# map; an axis nobody classified is priced as DCN — the expensive wire —
+# so forgetting to register a new axis overstates cost instead of hiding
+# it.
+AXIS_KINDS = {AXIS: "ici", "hosts": "dcn"}
+
+
+def axis_kind(name: str) -> str:
+    """Interconnect class of one mesh axis name ("ici" | "dcn")."""
+    return AXIS_KINDS.get(name, "dcn")
 
 
 def make_mesh(n_devices: int | None = None, axis_name: str = AXIS) -> Mesh:
