@@ -909,6 +909,177 @@ def bench_tail_ab(dg, plan=None, reps: int = 3, warm_rounds: int = 6):
     return rec
 
 
+def bench_packed_ab(n: int = 1_000_000, rounds: int = 8, reps: int = 3):
+    """Packed-NATIVE vs unpack/repack round-trip at headline scale (the
+    packed-native tentpole's measured claim): the same ``--packed`` loop
+    timed twice — once with the round computing ON the uint8 bit words
+    (sim/packed_engine), once through the retired shape that decoded the
+    full bool planes every round and re-packed the product — on the
+    local engine and the sharded-matching mesh. Alongside wall clock,
+    graftmem's static ledger prices each round trace at this scale:
+    peak-live over packed-resident, and the top source-line attribution
+    (the acceptance ask: no longer the ``unpack_bits`` codec line).
+    """
+    import functools
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from tpu_gossip.core.device_topology import device_powerlaw_graph
+    from tpu_gossip.core.packed import pack_state, unpack_state
+    from tpu_gossip.core.state import SwarmConfig, clone_state, init_swarm
+    from tpu_gossip.sim.engine import gossip_round, simulate
+
+    def graft(name, fn, state, n_peers):
+        """Static ledger + attribution of one round trace at scale."""
+        from tpu_gossip.analysis.deep.liveness import entry_liveness
+        from tpu_gossip.analysis.entrypoints import EntryPoint, TracedEntry
+        from tpu_gossip.analysis.mem.ledger import entry_ledger
+
+        ep = EntryPoint(
+            name=name, engine="bench", kind="round", audit_check="bench",
+            build=lambda: (fn, state), n_peers=n_peers, packed=True,
+        )
+        te = TracedEntry(ep=ep, state=state)
+        # drop cached helper jaxprs (jnp.where's jitted _where): a cached
+        # trace re-inlines with its ORIGINAL source_info, so a bool warm
+        # run would mislabel the packed round's attribution lines
+        jax.clear_caches()
+        te.jaxpr, te.out_shape = jax.make_jaxpr(fn, return_shape=True)(state)
+        led = entry_ledger(name, te)
+        live = entry_liveness(name, te)
+        return {
+            "peak_bytes_per_peer": round(led.peak_bytes / n, 2),
+            "resident_bytes_per_peer": round(led.state_bytes / n, 2),
+            "peak_over_resident": round(
+                led.peak_bytes / max(led.state_bytes, 1), 2
+            ),
+            "top_attribution": live["top"][0][0],
+        }
+
+    def timed(fn, mk_state):
+        jax.block_until_ready(fn(mk_state()))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            arg = mk_state()
+            jax.block_until_ready(arg)
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(arg))
+            best = min(best, _time.perf_counter() - t0)
+        return round(best / rounds * 1e3, 4)
+
+    # ---- local engine ---------------------------------------------------
+    dg = device_powerlaw_graph(n, gamma=2.5, key=jax.random.key(5))
+    cfg = SwarmConfig(
+        n_peers=dg.n_pad, msg_slots=16, fanout=1, mode="push_pull"
+    )
+    st = init_swarm(
+        dg.as_padded_graph(), cfg, origins=[0], exists=dg.exists,
+        key=jax.random.key(5),
+    )
+    st, _ = simulate(st, cfg, 4)  # mid-epidemic planes: real work per word
+
+    def native_local(ps):
+        fin, _stats = simulate(ps, cfg, rounds)
+        return fin
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def roundtrip_local(ps):
+        def body(p, _):
+            fin, _stats = gossip_round(unpack_state(p), cfg, None)
+            return pack_state(fin), None
+        out, _ = jax.lax.scan(body, ps, None, length=rounds)
+        return out
+
+    mk = lambda: pack_state(clone_state(st))  # noqa: E731
+    local = {
+        "native_ms_per_round": timed(native_local, mk),
+        "roundtrip_ms_per_round": timed(roundtrip_local, mk),
+        "graftmem_native": graft(
+            "bench[packed-native,local]",
+            lambda p: gossip_round(p, cfg, None)[0], mk(), dg.n_pad,
+        ),
+        "graftmem_roundtrip": graft(
+            "bench[packed-roundtrip,local]",
+            lambda p: pack_state(gossip_round(unpack_state(p), cfg, None)[0]),
+            mk(), dg.n_pad,
+        ),
+    }
+    local["native_over_roundtrip"] = round(
+        local["native_ms_per_round"]
+        / max(local["roundtrip_ms_per_round"], 1e-9), 3
+    )
+    del st, dg
+
+    # ---- sharded-matching engine ---------------------------------------
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.dist import make_mesh, shard_matching_plan, shard_swarm
+    from tpu_gossip.dist.mesh import gossip_round_dist, simulate_dist
+
+    mesh = make_mesh()
+    if 128 % mesh.size:
+        return {
+            "n_peers": n, "rounds": rounds, "local": local,
+            "dist_matching": {
+                "unsupported": f"mesh size {mesh.size} does not divide 128"
+            },
+        }
+    g, plan = matching_powerlaw_graph_sharded(
+        n, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    plan_m = shard_matching_plan(plan, mesh)
+    cfg_d = SwarmConfig(
+        n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull"
+    )
+    st0 = init_swarm(
+        g.as_padded_graph(), cfg_d, origins=np.arange(cfg_d.msg_slots),
+        origin_slots=np.arange(cfg_d.msg_slots), exists=g.exists,
+        key=jax.random.key(0),
+    )
+    std = shard_swarm(st0, mesh)
+
+    def native_dist(ps):
+        fin, _stats = simulate_dist(ps, cfg_d, plan_m, mesh, rounds)
+        return fin
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def roundtrip_dist(ps):
+        def body(p, _):
+            fin, _stats = gossip_round_dist(
+                unpack_state(p), cfg_d, plan_m, mesh
+            )
+            return pack_state(fin), None
+        out, _ = jax.lax.scan(body, ps, None, length=rounds)
+        return out
+
+    mkd = lambda: pack_state(clone_state(std))  # noqa: E731
+    dist = {
+        "devices": mesh.size,
+        "native_ms_per_round": timed(native_dist, mkd),
+        "roundtrip_ms_per_round": timed(roundtrip_dist, mkd),
+        "graftmem_native": graft(
+            "bench[packed-native,dist-matching]",
+            lambda p: gossip_round_dist(p, cfg_d, plan_m, mesh)[0],
+            mkd(), plan.n,
+        ),
+    }
+    dist["native_over_roundtrip"] = round(
+        dist["native_ms_per_round"]
+        / max(dist["roundtrip_ms_per_round"], 1e-9), 3
+    )
+    return {
+        "n_peers": n, "rounds": rounds, "msg_slots": 16,
+        "local": local, "dist_matching": dist,
+        "note": "roundtrip = the retired unpack->bool-round->repack loop "
+        "body; native computes on the uint8 words (graftmem attribution "
+        "names the residual full-width ops, not the codec)",
+    }
+
+
 def bench_pipeline(n: int, horizon: int = 24, reps: int = 1):
     """Pipelined vs serial sharded matching rounds at headline scale
     (ISSUE 10 acceptance): ms/round for the serial schedule vs the
@@ -1875,7 +2046,8 @@ def main(argv: list[str] | None = None) -> int:
         """True (and records the skip) when the budget is too spent for
         ``section`` — the guard that keeps rc=0 with the headline printed."""
         frac = {"tail_ab": 0.35, "north_star_10m": 0.40, "dist_200k": 0.70,
-                "dist_1m": 0.78, "grow_1m": 0.82, "stream_1m": 0.86,
+                "dist_1m": 0.78, "packed_ab_1m": 0.80, "grow_1m": 0.82,
+                "stream_1m": 0.86,
                 "control_1m": 0.88, "adv_1m": 0.885, "pipeline_1m": 0.89,
                 "ckpt_1m": 0.893, "fleet_1m": 0.895, "build_10m": 0.897,
                 "dist_10m": 0.90}[section]
@@ -2159,6 +2331,11 @@ def main(argv: list[str] | None = None) -> int:
                 "matching": bench_dist_matching(1_000_000, reps=reps),
             }
             flush_detail()
+        if not quick and not skip("packed_ab_1m"):
+            # packed-native vs unpack/repack at 1M on both engines — the
+            # compute-on-words tentpole's wall-clock + graftmem figures
+            out["packed_ab_1m"] = bench_packed_ab(1_000_000, reps=reps)
+            flush_detail()
         if not quick and not skip("grow_1m"):
             # the growth engine at 1M capacity: admission-stage overhead
             # (growing vs fixed-n round on the same state) + the grown
@@ -2359,6 +2536,21 @@ def _compact(out: dict) -> dict:
         compact["tail_ab"] = {
             "decision": t["decision"],
             "composed_ms_per_round": t["composed_ms_per_round"],
+        }
+    pk = out.get("packed_ab_1m")
+    if pk and "local" in pk:
+        compact["packed_ab_1m"] = {
+            "local_ms": [
+                pk["local"]["native_ms_per_round"],
+                pk["local"]["roundtrip_ms_per_round"],
+            ],
+            "dist_ms": [
+                pk["dist_matching"].get("native_ms_per_round"),
+                pk["dist_matching"].get("roundtrip_ms_per_round"),
+            ],
+            "peak_over_resident": pk["local"]["graftmem_native"][
+                "peak_over_resident"
+            ],
         }
     fl = out.get("fleet_1m")
     if fl and "lanes" in fl:

@@ -48,7 +48,8 @@ def test_broken_stats_dtype_detected(monkeypatch):
 
 def test_broken_state_shape_detected(monkeypatch):
     """Drop a peer row from the output state: the fixed-point contract
-    (out specs == in specs) must catch it."""
+    (out specs == in specs) must catch it — on the packed-native entry
+    too, whose row mask lives in the shared flags word."""
     from tpu_gossip.sim import engine
 
     orig = engine.gossip_round
@@ -57,7 +58,9 @@ def test_broken_state_shape_detected(monkeypatch):
         import dataclasses
 
         st, stats = orig(state, cfg, plan, **kw)
-        return dataclasses.replace(st, alive=st.alive[:-1]), stats
+        plane = "alive" if hasattr(st, "alive") else "flags"
+        return dataclasses.replace(
+            st, **{plane: getattr(st, plane)[:-1]}), stats
 
     monkeypatch.setattr(engine, "gossip_round", broken)
     findings = audit_contracts(names=["gossip_round_local"])

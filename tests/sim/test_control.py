@@ -456,6 +456,8 @@ def test_reliability_contract_holds_across_catalogue():
         assert rep["holds"], (os.path.basename(path), rep)
 
 
+@pytest.mark.slow  # demonstration pair (controller beats static);
+# the controlled bit-identity laws stay tier-1
 def test_static_fanout_misses_where_controller_holds():
     """The degraded scenario's demonstration pair: at the same config the
     STATIC fanout misses the delivery-ratio target the controller

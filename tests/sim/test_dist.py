@@ -192,7 +192,10 @@ def test_liveness_dist(setup):
     assert dead[-1] == 40
 
 
-@pytest.mark.parametrize("mode,fanout", [("push", 3), ("push_pull", 1)])
+@pytest.mark.parametrize(
+    "mode,fanout",
+    [pytest.param("push", 3, marks=pytest.mark.slow), ("push_pull", 1)],
+)  # one curve-parity witness in tier-1; the push lane rides slow
 def test_dist_local_curve_parity(setup, mode, fanout):
     """Quantified parity bound (VERDICT r2 item 5): dist samples Bernoulli
     k/deg per edge where the local engine samples exactly-k neighbors; the
@@ -232,9 +235,12 @@ def test_dist_local_curve_parity(setup, mode, fanout):
         ("push_pull", {}),
         ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
                            rewire_slots=2)),
-        ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
-                           rewire_slots=2, rewire_compact_cap=64)),
-    ],
+        pytest.param("push_pull",
+                     dict(churn_leave_prob=0.01, churn_join_prob=0.1,
+                          rewire_slots=2, rewire_compact_cap=64),
+                     marks=pytest.mark.slow),
+    ],  # churn keeps the re-wiring receive path in tier-1; the compact
+    # twin asserts the same law and rides the slow lane
     ids=["flood", "push", "push_pull", "push_pull_churn",
          "push_pull_churn_compact"],
 )
@@ -356,7 +362,8 @@ def _matching_state(g, cfg, seed=3, origins=(0, 5)):
                      dict(churn_leave_prob=0.02, churn_join_prob=0.2,
                           rewire_slots=2, rewire_compact_cap=64),
                      marks=pytest.mark.slow),
-        ("push_pull", dict(sir_recover_rounds=2)),
+        pytest.param("push_pull", dict(sir_recover_rounds=2),
+                     marks=pytest.mark.slow),
         # forward_once is the only config taking the answer-bitmap branch
         # (a second expand+pipeline pass per word group inside shard_map)
         ("push_pull", dict(forward_once=True)),
@@ -727,6 +734,8 @@ def test_matching_dist_adversary_composed_bit_identical(matching_setup):
     assert int(np.asarray(stats_l.adv_accusations).sum()) > 0
 
 
+@pytest.mark.slow  # the matching scenario-parity flood witness keeps
+# scenario kernel-parity in tier-1; the bucketed twin rides slow
 def test_bucketed_scenario_kernel_receive_parity(setup):
     """The staircase-kernel receive path under an active scenario stays
     bit-identical to the scatter receive — the fault stage wraps the

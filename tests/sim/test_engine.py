@@ -278,6 +278,8 @@ def test_round_counter_and_rng_advance(graph):
     )
 
 
+@pytest.mark.slow  # the ckpt matrices + CI recovery drill keep resume
+# equivalence covered; this full-machine compose rides slow
 def test_resume_equivalence_full_state_machine(tmp_path):
     """Checkpoint/resume is lossless mid-run: simulate(4) + save/load +
     simulate(4) must be BIT-EXACT vs simulate(8) uninterrupted — the RNG

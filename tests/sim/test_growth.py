@@ -260,6 +260,8 @@ def test_matching_growth_admissions_spread_across_shards(
 # --- degree evolution: the grown tail matches the generator's ------------
 
 
+@pytest.mark.slow  # statistical gamma-fit demonstration; the growth
+# bit-identity and admission laws stay tier-1
 def test_grown_swarm_gamma_matches_generator():
     """Grow a BA seed 4k -> 24k by in-round PA (attach_m = the
     generator's m) and demand the realized degree tail's γ-MLE land
@@ -337,6 +339,8 @@ def test_grown_swarm_gamma_matches_generator_1m():
 # --- checkpointing (satellite: the registry plane round-trips) -----------
 
 
+@pytest.mark.slow  # the ckpt matrices + mid-stream twin keep
+# mid-flight resume in tier-1; this compose rides slow
 def test_mid_growth_checkpoint_resumes_bit_exactly(tmp_path):
     cfg, st, gp = grown_setup()
     mid, _ = simulate(st, cfg, 4, None, "fused", None, gp)

@@ -1,0 +1,175 @@
+"""Packed-NATIVE round stages (sim/packed_engine, kernels/packed_ops):
+per-stage word-vs-bool oracles for the hot path that now computes ON the
+uint8 bit words — the round head (roles + transmit + forward-once
+latch), the word-native delivery (merge/dedup as word OR/AND/ANDN,
+billing as popcounts), the popcount == sum law at a ragged tail
+(M % 8 != 0: padding bits must never leak into counts), and the packed
+byte wire riding the sparse transport's dense-overflow fallback.
+
+The loop-level bit-identity pins live in tests/sim/test_packed.py; this
+file pins each STAGE against its bool twin so a word-algebra regression
+names the stage, not just "the round diverged".
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_gossip import SwarmConfig, build_csr, preferential_attachment
+from tpu_gossip.core.packed import pack_bits, pack_state, unpack_bits, unpack_state
+from tpu_gossip.core.state import clone_state, init_swarm
+from tpu_gossip.kernels import packed_ops as po
+from tpu_gossip.sim import engine as _engine
+from tpu_gossip.sim.packed_engine import (
+    _decode_flags,
+    _disseminate_local_packed,
+    packed_round_head,
+)
+
+N = 257  # not divisible by 8: ragged row counts ride along
+
+
+def _state_for(m, **cfg_kw):
+    g = build_csr(N, preferential_attachment(N, m=3, use_native=False))
+    cfg = SwarmConfig(n_peers=N, msg_slots=m, fanout=2, **cfg_kw)
+    st = init_swarm(g, cfg, origins=[0, 3], key=jax.random.key(2))
+    # a mid-epidemic shape: extra seen slots, some forwarded, some
+    # recovered — every branch of the head algebra has work to do
+    key = jax.random.key(11)
+    k1, k2, k3 = jax.random.split(key, 3)
+    seen = st.seen | (jax.random.bernoulli(k1, 0.3, st.seen.shape)
+                      & st.exists[:, None])
+    st = dataclasses.replace(
+        st,
+        seen=seen,
+        forwarded=seen & jax.random.bernoulli(k2, 0.4, seen.shape),
+        recovered=jax.random.bernoulli(k3, 0.1, seen.shape),
+    )
+    return st, cfg
+
+
+# ------------------------------------------------------------- round head
+@pytest.mark.parametrize("m", [16, 13], ids=["aligned", "ragged"])
+@pytest.mark.parametrize("forward_once", [False, True],
+                         ids=["plain", "fwd_once"])
+def test_round_head_words_match_bool_oracle(m, forward_once):
+    """packed_round_head == compute_roles + transmit_bitmap, decoded:
+    role words, the transmit plane, and the forward-once ANDN latch are
+    the bool masks bit for bit (padding words stay zero)."""
+    st, cfg = _state_for(m, mode="push_pull", forward_once=forward_once)
+    ps = pack_state(st)
+    flags = _decode_flags(ps)
+    active_w, role_w, tx_w = packed_round_head(ps, cfg, flags, None)
+
+    active, transmitter, receptive = _engine.compute_roles(st)
+    transmit = _engine.transmit_bitmap(st, cfg, transmitter)
+    np.testing.assert_array_equal(np.asarray(active_w), np.asarray(active))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bits(role_w, m)), np.asarray(transmitter))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bits(tx_w, m)), np.asarray(transmit))
+    # padding bits of the last word stay zero — the invariant every
+    # popcount and rows_any depends on
+    if m % 8:
+        tail = np.asarray(tx_w)[:, -1]
+        assert not (tail >> (m % 8)).any()
+
+
+# --------------------------------------------------- word-native delivery
+@pytest.mark.parametrize("mode", ["push", "push_pull"])
+@pytest.mark.parametrize("forward_once", [False, True],
+                         ids=["plain", "fwd_once"])
+def test_delivery_merge_dedup_words_match_bool_oracle(mode, forward_once):
+    """The word-native delivery (gather + OR-fold merge, popcount
+    billing) returns the SAME incoming plane and message count as the
+    bool `_disseminate_local` under identical keys — the merge/dedup
+    algebra on words is the bool algebra, not an approximation."""
+    st, cfg = _state_for(16, mode=mode, forward_once=forward_once)
+    ps = pack_state(st)
+    flags = _decode_flags(ps)
+    _, role_w, tx_w = packed_round_head(ps, cfg, flags, None)
+    kp, kq = jax.random.split(jax.random.key(7))
+
+    inc_w, msgs_w = _disseminate_local_packed(
+        ps, cfg, flags, role_w, tx_w, kp, kq, None, None)
+
+    _, transmitter, receptive = _engine.compute_roles(st)
+    transmit = _engine.transmit_bitmap(st, cfg, transmitter)
+    inc_b, msgs_b = _engine._disseminate_local(
+        st, cfg, transmit, transmitter, receptive, kp, kq, None, None)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bits(inc_w, 16)), np.asarray(inc_b))
+    assert int(msgs_w) == int(msgs_b)
+
+
+# --------------------------------------------------- popcount == sum law
+@pytest.mark.parametrize("m", [13, 17, 8, 1], ids=["m13", "m17", "m8", "m1"])
+def test_popcount_rows_equals_bool_sum_ragged(m):
+    """po.popcount_rows(pack_bits(b)) == b.sum(-1, int32) including at
+    M % 8 != 0 — the ragged tail's padding bits contribute nothing, and
+    the result dtype is the stats contract's int32 (uint8 popcounts that
+    sum in uint8 would wrap at 256)."""
+    rng = np.random.default_rng(3)
+    b = jnp.asarray(rng.random((301, m)) < 0.5)
+    counts = po.popcount_rows(pack_bits(b))
+    assert counts.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.asarray(b.sum(-1, dtype=jnp.int32)))
+    # and the word-shape nonzero test agrees with any()
+    np.testing.assert_array_equal(
+        np.asarray(po.rows_any(pack_bits(b))), np.asarray(b.any(-1)))
+
+
+# ----------------------------------------- packed wire, overflow fallback
+def test_packed_wire_sparse_overflow_roundtrip():
+    """A packed mesh run under sparse transport whose occupancy exceeds
+    the compact budget: the runtime gate must ride the DENSE lane
+    (sparse_lanes == 0) shipping the packed byte planes, and the
+    trajectory must stay bit-identical to the unpacked dense run —
+    the overflow fallback round-trips words, not re-decoded bools."""
+    from tpu_gossip.dist import (
+        build_transport,
+        init_sharded_swarm,
+        make_mesh,
+        partition_graph,
+        shard_swarm,
+        simulate_dist,
+    )
+
+    n = 997
+    g = build_csr(n, preferential_attachment(n, m=3, use_native=False))
+    mesh = make_mesh(8)
+    sg, relabeled, position = partition_graph(g, 8, seed=1)
+    tr = build_transport(sg, mode="sparse")
+    cfg = SwarmConfig(n_peers=sg.n_pad, msg_slots=8, mode="flood")
+    st0 = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
+    # everyone transmits: every valid bucket entry is occupied, so the
+    # compact lane cannot fit and the gate must fall back
+    st0 = dataclasses.replace(st0, seen=st0.seen.at[:, 0].set(st0.exists))
+    st = shard_swarm(st0, mesh)
+    fin_a, stats_a = simulate_dist(clone_state(st), cfg, sg, mesh, 2)
+    fin_p, (stats_p, ici) = simulate_dist(
+        pack_state(clone_state(st)), cfg, sg, mesh, 2, None, None, None,
+        tr, True,
+    )
+    fin_b = unpack_state(fin_p)
+    for f in ("seen", "alive", "declared_dead", "recovered", "exists"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fin_a, f)), np.asarray(getattr(fin_b, f)),
+            err_msg=f,
+        )
+    for f in stats_a._fields:
+        if f == "degree_gamma":
+            np.testing.assert_allclose(
+                np.asarray(stats_a.degree_gamma),
+                np.asarray(stats_p.degree_gamma), rtol=5e-7)
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(stats_a, f)),
+            np.asarray(getattr(stats_p, f)), err_msg=f)
+    assert int(np.asarray(ici.sparse_lanes)[0]) == 0
+    assert int(np.asarray(ici.shipped_words)[0]) > int(
+        np.asarray(ici.dense_words)[0])  # dense + header, honestly priced

@@ -175,6 +175,8 @@ def test_churn_with_periodic_remat_sustains_coverage():
         assert ((t == -1) | ((t >= 0) & (t < n))).all()
 
 
+@pytest.mark.slow  # the composed remat-then-repartition drill; the
+# periodic-remat coverage test keeps the remat law in tier-1
 def test_remat_then_repartition_back_onto_mesh():
     """The dist epoch-rebuild cycle: dist churn rounds → re-materialize the
     accumulated fresh edges → repartition_swarm → resume on the mesh. The

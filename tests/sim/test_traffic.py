@@ -132,7 +132,12 @@ def test_age_out_recycles_seeded_epidemic_through_tail():
     np.testing.assert_array_equal(age[:5], [1, 2, 3, 4, -1][:5])
 
 
-@pytest.mark.parametrize("tail", ["reference", "fused", "pallas"])
+@pytest.mark.parametrize(
+    "tail",
+    [pytest.param("reference", marks=pytest.mark.slow), "fused",
+     pytest.param("pallas", marks=pytest.mark.slow)],
+)  # fused (the default) is the tier-1 witness; the other tails assert
+# the same law and ride the slow lane
 def test_stream_bit_identical_across_tails(tail):
     """The expired-column mask rides all three tail implementations
     bit-identically — the streaming extension of the round-tail
@@ -351,8 +356,9 @@ def _matching_rows(plan, ids):
         ("flood", "hotspot", None),
         pytest.param("push_pull", "uniform", "scenario",
                      marks=pytest.mark.slow),
-        ("push_pull", "uniform", "growth"),
-    ],  # two loaded-run parity witnesses in tier-1, two on the slow lane
+        pytest.param("push_pull", "uniform", "growth",
+                     marks=pytest.mark.slow),
+    ],  # one loaded-run parity witness in tier-1, three on the slow lane
     ids=["push_pull", "flood_hotspot", "chaos_scenario", "flash_crowd"],
 )
 def test_matching_stream_local_vs_sharded_bit_identical(
@@ -591,6 +597,8 @@ def test_steady_state_report_on_loaded_run():
     assert rep["msgs_offered"] >= rep["msgs_injected"]
 
 
+@pytest.mark.slow  # load-collapse demonstration; the counter-balance
+# and stream bit-identity laws stay tier-1
 def test_saturation_collapses_delivery_ratio():
     """The saturation story the bench curve measures, at test scale: at a
     few messages per round the swarm delivers nearly every closed

@@ -354,6 +354,8 @@ def test_pairing_reach_spans_all_rows():
     assert sample.max() > r / 2
 
 
+@pytest.mark.slow  # the no-CSR build path also runs in CI's
+# builder-smoke job; rides the slow lane locally
 def test_build_without_csr_export_runs_dissemination():
     """export_csr=False: degree-true row_ptr, empty neighbor list, and the
     full matching round (push_pull + SIR + liveness) still runs — churn

@@ -326,8 +326,9 @@ def main(argv: list[str] | None = None) -> int:
         return _print_planes(args)
 
     if args.deep_selftest:
-        # the gate that keeps the gate honest: both adversarial fixtures
-        # (divergent collective, out-of-codec unpack) must still FIRE
+        # the gate that keeps the gate honest: the adversarial fixtures
+        # (divergent collective, out-of-codec unpack) must still FIRE and
+        # the sanctioned word-kernel fixture must stay clean
         _ensure_multi_device_env()
         from tpu_gossip.analysis.deep.selftest import run_selftest
 
@@ -336,7 +337,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"deep-selftest FAIL: {msg}", file=sys.stderr)
         print(
             "deep-selftest: "
-            + ("both adversarial fixtures fired"
+            + ("adversarial fixtures fired, word-kernel fixture clean"
                if not failures else f"{len(failures)} dead rail(s)"),
             file=sys.stderr,
         )

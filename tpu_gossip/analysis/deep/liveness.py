@@ -16,19 +16,24 @@ planes dominate the top of the breakdown.
 The rule (``deep-transient-liveness``) is the codec rail that keeps that
 spike CONTAINED: in a ``--packed`` entry, the packed storage words
 (the uint8 bit-planes named in ``core.packed.BIT_PLANES`` + the shared
-``flags`` word) may only be decoded inside the sanctioned codec in
-``core/packed.py``. A hand-rolled shift-and-mask decode anywhere else
-materializes a second full-width (N, M) bool plane the ledger's budget
-never priced — and silently forks the bit-order contract. Detection is
-a taint walk: entry state leaves that are packed words seed the taint;
-structural ops (reshape/slice/transpose/...) and control-flow
-boundaries propagate it; codec equations (source file
-``core/packed.py``) may consume it freely — their uint8 outputs are
-re-packed words (tainted), their bool outputs are sanctioned decoded
-planes (clean) — and any other equation consuming a tainted var is a
-finding. This is also the register where the ROADMAP's packed-native
-kernels will live: a sanctioned bit-wise kernel extends the codec file
-(or earns an explicit pragma), it does not silently decode.
+``flags`` word) may be COMPUTED ON at word level — bitwise OR/AND/ANDN,
+popcounts, nonzero tests — anywhere in the kernel tier
+(``kernels/``, ``dist/``, ``core/matching_topology.py``: the packed-
+native round kernels and the byte wire), but only DECODED to full bool
+width inside the sanctioned codec in ``core/packed.py``. A hand-rolled
+shift-and-mask decode anywhere else materializes a second full-width
+(N, M) bool plane the ledger's budget never priced — and silently forks
+the bit-order contract. Detection is a taint walk: entry state leaves
+that are packed words seed the taint; structural ops
+(reshape/slice/transpose/...) and control-flow boundaries propagate it;
+codec equations (source file ``core/packed.py``) may consume it freely
+— their uint8 outputs are re-packed words (tainted), their bool outputs
+are sanctioned decoded planes (clean); kernel-tier equations may
+consume it at word level — uint8 outputs are still words (tainted),
+narrow products (popcount sums, row indicators, nonzero tests at word
+shape) are clean — but a kernel-tier BOOL output WIDER than the widest
+tainted operand is a decode wearing a kernel's clothes, and a finding;
+and any other equation consuming a tainted var is a finding.
 
 Docs: docs/static_analysis.md (deep-tier catalogue + "reading a
 transient-liveness finding"). Self-test fixture:
@@ -45,8 +50,19 @@ __all__ = ["RULE", "entry_liveness", "liveness_findings", "codec_findings"]
 
 RULE = "deep-transient-liveness"
 
-# the one source file licensed to touch packed storage words
+# the one source file licensed to DECODE packed storage words to full
+# bool width
 _CODEC_FILE = "tpu_gossip/core/packed.py"
+
+# the kernel tier licensed to COMPUTE ON the words (bitwise/popcount at
+# word width — the packed-native round kernels and the byte wire); a
+# decode-to-bool-width here is still a finding
+_WORD_TIER = (
+    "tpu_gossip/core/packed.py",
+    "tpu_gossip/core/matching_topology.py",
+    "tpu_gossip/kernels/",
+    "tpu_gossip/dist/",
+)
 
 # prims that move/reshape a buffer without computing on its bits: they
 # propagate the packed-words taint but are not themselves a decode
@@ -174,6 +190,7 @@ def codec_findings(name: str, te) -> list[Finding]:
                 continue
             src = src_of(eqn)
             in_codec = src is not None and src.file == _CODEC_FILE
+            in_tier = src is not None and src.file.startswith(_WORD_TIER)
             if in_codec:
                 # the sanctioned codec: uint8 outputs are (re)packed
                 # words — still storage; bool outputs are decoded planes
@@ -189,6 +206,28 @@ def codec_findings(name: str, te) -> list[Finding]:
                         v for v in eqn.outvars if isinstance(v, core.Var)
                     )
             elif any_taint:
+                widest = max(
+                    (int(a.aval.size) for a in eqn.invars
+                     if isinstance(a, core.Var) and a in tainted
+                     and hasattr(a, "aval")),
+                    default=0,
+                )
+                widened = [
+                    v for v in eqn.outvars
+                    if getattr(getattr(v, "aval", None), "dtype", None)
+                    == np.bool_
+                    and int(getattr(v.aval, "size", 0)) > widest
+                ]
+                if in_tier and not widened:
+                    # the kernel tier computes ON the words: uint8
+                    # outputs are still packed words; popcounts, row
+                    # indicators, word-shape nonzero tests are narrow
+                    # clean products
+                    for v in eqn.outvars:
+                        dt = getattr(getattr(v, "aval", None), "dtype", None)
+                        if dt == np.uint8:
+                            tainted.add(v)
+                    continue
                 site = (src.file, src.line, prim) if src else (None, 0, prim)
                 if site in seen_sites:
                     continue
@@ -198,22 +237,26 @@ def codec_findings(name: str, te) -> list[Finding]:
                     f"{list(getattr(v.aval, 'shape', ()))}"
                     for v in eqn.outvars if hasattr(v, "aval")
                 )
+                what = (
+                    "decoded to full bool width by"
+                    if in_tier else "consumed by"
+                )
                 findings.append(Finding(
                     file=src.file if src else f"<deep:{name}>",
                     line=src.line if src else 0,
                     col=0,
                     rule=RULE,
                     message=(
-                        f"packed storage words consumed by `{prim}` "
+                        f"packed storage words {what} `{prim}` "
                         f"outside the sanctioned codec (-> {out_shapes}) "
                         "— a hand-rolled decode materializes a second "
                         "full-width plane the memory budget never "
                         "priced, and forks the bit-order contract"
                     ),
                     hint="decode through core/packed.py "
-                    "(unpack_bits/unpack_flag/bit_column), or move the "
-                    "bit-wise kernel into the codec where the ledger "
-                    "prices its transient",
+                    "(unpack_bits/unpack_flag/bit_column); word-level "
+                    "bitwise/popcount ops belong in the kernel tier "
+                    "(kernels/, dist/) where the rail licenses them",
                     qualname=(
                         f"{name}:{src.function}" if src else name
                     ),

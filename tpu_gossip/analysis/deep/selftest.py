@@ -14,11 +14,17 @@ and asserts the passes still report them:
   LSB-first shift-and-mask decode OUTSIDE ``core/packed.py``,
   materializing a full-width (N, M) bool plane the budget never priced.
   ``deep-transient-liveness`` must name this file's decode line.
+- :func:`word_kernel_entry` — the GOOD twin: the packed-native round
+  shape (word-level bitwise/popcount ops through ``kernels/packed_ops``,
+  decode only via the codec). ``deep-transient-liveness`` must stay
+  SILENT on it — a rail that flags the sanctioned kernels would push
+  every packed-native op behind pragmas and rot the gate the other way.
 
-:func:`run_selftest` runs both and returns the failures (empty = the
-rails fire). CI runs it as a step of the lint-deep job
-(``python -m tpu_gossip.analysis --deep-selftest``); the same fixtures
-back tests/analysis/test_collectives.py / test_liveness.py.
+:func:`run_selftest` runs all three and returns the failures (empty =
+the rails fire where they must and only there). CI runs it as a step of
+the lint-deep job (``python -m tpu_gossip.analysis --deep-selftest``);
+the same fixtures back tests/analysis/test_collectives.py /
+test_liveness.py.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ from __future__ import annotations
 __all__ = [
     "divergent_collective_entry",
     "unpack_spike_entry",
+    "word_kernel_entry",
     "run_selftest",
 ]
 
@@ -103,6 +110,37 @@ def unpack_spike_entry():
     )
 
 
+def word_kernel_entry():
+    """(name, TracedEntry): the sanctioned packed-native kernel shape."""
+    import jax.numpy as jnp
+
+    from tpu_gossip.core.packed import bit_column, pack_bits
+    from tpu_gossip.kernels import packed_ops as po
+
+    words = pack_bits(
+        (jnp.arange(_N_FIXTURE * _M_FIXTURE) % 3 == 0).reshape(
+            _N_FIXTURE, _M_FIXTURE
+        )
+    )
+
+    def good(state):
+        w = state["seen"]
+        # one round's worth of word algebra: merge, stale-filter,
+        # forward-once latch, popcount billing — all at word width in
+        # the kernel tier, plus a codec bit_column read
+        merged = po.or_words(w, po.andnot_words(w, w))
+        latched = po.and_words(merged, po.not_words(w, _M_FIXTURE))
+        return (
+            jnp.sum(po.popcount_rows(latched))
+            + jnp.sum(po.rows_any(merged))
+            + jnp.sum(bit_column(w, 0))
+        )
+
+    return _entry(
+        "selftest[word-kernel]", good, {"seen": words}, packed=True
+    )
+
+
 def run_selftest() -> list[str]:
     """Run both adversarial fixtures; returns failure descriptions
     (empty = both rails fire)."""
@@ -135,5 +173,14 @@ def run_selftest() -> list[str]:
     ):
         failures.append(
             f"{name}: {LIVE_RULE} did not fire on an out-of-codec decode"
+        )
+
+    name, te = word_kernel_entry()
+    findings = codec_findings(name, te)
+    if findings:
+        failures.append(
+            f"{name}: {LIVE_RULE} fired on sanctioned word-level kernel "
+            f"ops ({findings[0].file}:{findings[0].line} "
+            f"{findings[0].message[:60]}…)"
         )
     return failures

@@ -357,7 +357,7 @@ def _local_entries() -> list[EntryPoint]:
     # tail that drops, reshapes, or re-types a slot array cannot reach a
     # scan/while_loop carry without failing here first. Churn + SIR ride
     # along so the fresh-mask and recovery branches are traced too.
-    for tail in ("reference", "fused", "pallas"):
+    for tail in ("reference", "fused", "pallas", "packed", "packed_pallas"):
         eps.append(round_ep(
             f"local[xla,tail={tail}]", "xla", ctx["dg"], 16, None,
             dict(mode="push_pull", sir_recover_rounds=4, **churn),
@@ -628,6 +628,28 @@ def _local_entries() -> list[EntryPoint]:
         packed=True,
     ))
 
+    # the PACKED-NATIVE round: a PackedSwarm input routes through
+    # sim/packed_engine, so this trace IS the word-level round — the
+    # deep codec rail walks it (bitwise/popcount licensed in the kernel
+    # tier, decode only through core/packed.py) and the fixed-point
+    # check pins PackedSwarm -> PackedSwarm with the scalar int32 stats
+    # contract unchanged. forward_once engages the word-level latch
+    # (ANDN), SIR the recovered stale filter — the full dedup algebra
+    def build_round_packed():
+        from tpu_gossip.core.packed import pack_state
+
+        st, cfg = ctx["state_for"](
+            ctx["dg"], 16, mode="push_pull", sir_recover_rounds=4,
+            forward_once=True,
+        )
+        return lambda s: engine.gossip_round(s, cfg, None), pack_state(st)
+
+    eps.append(EntryPoint(
+        name="local[xla,round,packed-native]", engine="xla", kind="round",
+        audit_check="gossip_round_local", build=build_round_packed,
+        n_peers=ctx["dg"].n_pad, packed=True,
+    ))
+
     # the BATCHED fleet entry (fleet/): a composed scenario×stream×
     # control campaign vmapped over _FLEET_LANES lanes — the batched
     # round must stay a state fixed point AT BATCH RANK (the stacked
@@ -857,6 +879,47 @@ def _dist_entries() -> list[EntryPoint]:
         kind="simulate", audit_check="gossip_round_dist",
         build=build_dist_sim_packed, stats_leading=(_DIST_SIM_ROUNDS,),
         jit_name="simulate_dist", n_peers=plan.n, packed=True,
+    ))
+
+    # the PACKED-NATIVE mesh rounds: a PackedSwarm input routes each
+    # engine through its word-native exchange — the matching pipeline
+    # moves uint8 byte planes end to end (rewire_slots == 0), the
+    # bucketed engine ships packed words on the wire and decodes once at
+    # the delivery boundary. The deep codec rail audits both traces; the
+    # wire audit prices the uint8 operands against dense_wire_words
+    def build_dist_round_packed():
+        from tpu_gossip.core.packed import pack_state
+
+        st, cfg = dctx["m_state"]()
+        from tpu_gossip.dist import mesh as mm
+
+        return (
+            lambda s: mm.gossip_round_dist(s, cfg, plan, mesh),
+            pack_state(st),
+        )
+
+    eps.append(EntryPoint(
+        name="dist[matching,round,packed-native]", engine="dist-matching",
+        kind="round", audit_check="gossip_round_dist",
+        build=build_dist_round_packed, n_peers=plan.n, packed=True,
+    ))
+
+    def build_dist_round_packed_bucketed():
+        from tpu_gossip.core.packed import pack_state
+
+        st, cfg = dctx["b_state"]()
+        from tpu_gossip.dist import mesh as mm
+
+        return (
+            lambda s: mm.gossip_round_dist(s, cfg, sg, mesh),
+            pack_state(st),
+        )
+
+    eps.append(EntryPoint(
+        name="dist[bucketed,round,packed-native]", engine="dist-bucketed",
+        kind="round", audit_check="gossip_round_dist",
+        build=build_dist_round_packed_bucketed, n_peers=sg.n_pad,
+        packed=True,
     ))
     eps.append(dist_ep(
         "dist[bucketed,run_until_coverage]", "dist-bucketed",

@@ -308,11 +308,15 @@ def build_parser() -> argparse.ArgumentParser:
         "docs/memory_budget.md): the scan/while carry — what stays "
         "resident between rounds, and what checkpoints write — is the "
         "registry's packed storage ledger (67 B/peer at m=16 vs 142 "
-        "unpacked); each round runs unpack -> the identical round "
-        "program -> repack, so the trajectory is BIT-IDENTICAL to the "
-        "unpacked run (test-pinned across the composed matrix). Works "
-        "on every engine path except --profile-round and the remat "
-        "epoch loops (which fold the unpacked CSR between segments)",
+        "unpacked); the round itself computes NATIVELY on the bit "
+        "words (sim/packed_engine.py: word OR/AND/ANDN delivery and "
+        "dedup, popcount counts, packed wire at ~1/8 the dist bytes), "
+        "decoding full width only at licensed stages, and the "
+        "trajectory — state AND integer stats — is BIT-IDENTICAL to "
+        "the unpacked run (test-pinned across the composed matrix). "
+        "Works on every engine path except --profile-round and the "
+        "remat epoch loops (which fold the unpacked CSR between "
+        "segments)",
     )
     p.add_argument(
         "--builder", choices=["local", "dist"], default="local",
@@ -1681,11 +1685,14 @@ def _compile_cli_pipeline(args):
     return compile_pipeline(args.pipeline)
 
 
-def _transport_summary(args, ici=None, rounds=0) -> dict:
+def _transport_summary(args, ici=None, rounds=0, graph=None) -> dict:
     """Summary-row transport fields for a --shard run: the configured lane
     plus, when the analytic counter ran, realized occupancy/bytes —
     dense vs shipped vs occupied, bytes/round (dist/transport.IciRound;
-    word counters summed in int64 host-side so long runs can't wrap)."""
+    word counters summed in int64 host-side so long runs can't wrap).
+    ``graph`` (the ShardedGraph / MatchingPlan) adds ``dense_bool``: the
+    retired bool-plane wire's analytic bytes/round — the reference the
+    packed-native wire's ~8x reduction is quoted against."""
     if not args.shard:
         return {}
     out = {"transport": args.transport}
@@ -1704,6 +1711,17 @@ def _transport_summary(args, ici=None, rounds=0) -> dict:
             tot["dense_words"] / max(tot["shipped_words"], 1), 3
         ),
     }
+    if graph is not None:
+        from tpu_gossip.core.matching_topology import MatchingPlan
+
+        if isinstance(graph, MatchingPlan):
+            from tpu_gossip.dist.matching_mesh import dense_wire_words
+        else:
+            from tpu_gossip.dist.mesh import dense_wire_words
+        out["ici_bytes_per_round"]["dense_bool"] = round(4 * dense_wire_words(
+            graph, args.slots, args.mode, args.forward_once,
+            bool_planes=True,
+        ), 1)
     out["sparse_lanes"] = {
         "taken": tot["sparse_lanes"], "gated": tot["total_lanes"],
     }
@@ -2387,7 +2405,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
             summary = _horizon_summary(
                 args, stats, devices=n_build,
                 **_scenario_summary(spec, stats),
-                **_transport_summary(args, ici, args.rounds),
+                **_transport_summary(args, ici, args.rounds, plan),
                 **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
@@ -2427,7 +2445,7 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
             summary = {"summary": True, "mode": args.mode,
                        "devices": mesh.size, "delivery": "matching",
                        **_scenario_summary(spec),
-                       **_transport_summary(args, ici, rounds),
+                       **_transport_summary(args, ici, rounds, plan),
                        **_pipeline_summary(args),
                        **_control_summary(args),
                        **_liveness_summary(args),
@@ -2575,7 +2593,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
             summary = _horizon_summary(
                 args, stats, devices=mesh.size,
                 **_scenario_summary(spec, stats),
-                **_transport_summary(args, ici, args.rounds),
+                **_transport_summary(args, ici, args.rounds, sg),
                 **_pipeline_summary(args),
                 **_stream_summary(args, cfg, stats),
                 **_control_summary(args, cfg, stats),
@@ -2616,7 +2634,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                 )
             summary = {"summary": True, "mode": args.mode, "devices": mesh.size,
                        **_scenario_summary(spec),
-                       **_transport_summary(args, ici, rounds),
+                       **_transport_summary(args, ici, rounds, sg),
                        **_pipeline_summary(args),
                        **_control_summary(args),
                        **_liveness_summary(args),

@@ -20,16 +20,26 @@ ROADMAP's memory item names ("the bool planes materialize 8 bits per 1",
 :class:`~tpu_gossip.core.state.SwarmState`: same plane names, packed
 words where the registry declares a packing, every other plane carried
 verbatim. :func:`pack_state`/:func:`unpack_state` are EXACT inverses
-(integer ops only, test-pinned), which is what makes the packed runtime
-contract cheap to state: the round entry points (``sim.engine.simulate``
-/ ``run_until_coverage`` and the dist twins) accept a PackedSwarm and
-run each round as unpack -> the IDENTICAL round program -> repack, so a
-packed run's trajectory is BIT-IDENTICAL to the unpacked run's by
-construction — the scan/while carry (what stays resident between
-rounds, what a 100M swarm holds in HBM) is the packed pytree, and the
-unpacked planes are round-transient. The checkpoint stores (ckpt/store,
-the legacy npz) write the same packed words via numpy twins of these
-helpers (``np.packbits(..., bitorder="little")`` matches the LSB-first
+(integer ops only, test-pinned). The round entry points
+(``sim.engine.simulate`` / ``run_until_coverage`` and the dist twins)
+accept a PackedSwarm and run the round NATIVELY on the words
+(``sim/packed_engine.py``, ``kernels/packed_ops.py``): delivery and
+dedup are word OR/AND/ANDN, infection counts are popcounts, the round
+tail has ``packed``/``packed_pallas`` implementations in the same
+bit-identity harness as the full-width tails, and the transport ships
+the words themselves. Where a stage genuinely needs full width (the
+``infected_round`` int16 latch, the fault head under an active
+scenario, the pipelined/rewire paths) it decodes exactly that plane for
+exactly that stage — the codec is the licensed boundary, not the
+per-round tax. A packed run's trajectory (state AND integer stats) is
+BIT-IDENTICAL to the unpacked run's, test-enforced per stage
+(``tests/sim/test_packed_native.py``) and end-to-end
+(``tests/sim/test_packed.py``); the scan/while carry — what a 100M
+swarm holds in HBM between rounds — is the packed pytree, and peak
+live bytes hug the packed resident size instead of the 142 B/peer
+full-width transient. The checkpoint stores (ckpt/store, the legacy
+npz) write the same packed words via numpy twins of these helpers
+(``np.packbits(..., bitorder="little")`` matches the LSB-first
 convention exactly), so a checkpoint byte is never wider than the
 registry says it has to be.
 
@@ -57,6 +67,9 @@ __all__ = [
     "pack_bits",
     "unpack_bits",
     "bit_column",
+    "word_mask",
+    "words8_to_words32",
+    "words32_to_words8",
     "pack_flags",
     "unpack_flag",
     "pack_state",
@@ -120,6 +133,50 @@ def bit_column(words: jax.Array, slot: int) -> jax.Array:
     accessor the coverage/while-loop paths use so a packed carry never
     unpacks whole planes just to read one slot."""
     return (words[..., slot // 8] >> np.uint8(slot % 8)) & jnp.uint8(1) != 0
+
+
+def word_mask(m: int) -> jax.Array:
+    """(W,) uint8 constant with exactly the first ``m`` bits set.
+
+    THE ragged-tail convention (docs/memory_budget.md): every packed
+    plane keeps its padding bits (slots ``m..8W``) at zero, so OR/AND of
+    two conforming planes conforms for free and popcounts need no mask.
+    The one operation that can manufacture padding ones is bitwise NOT —
+    word-level negation must always be written ``~w & word_mask(m)``
+    (see ``kernels.packed_ops.not_words``), which this constant exists
+    for. Built host-side: a trace-time constant, never a traced op.
+    """
+    w = packed_width(m)
+    bits = np.arange(w * 8) < m
+    return jnp.asarray(np.packbits(bits, bitorder="little"), dtype=jnp.uint8)
+
+
+def words8_to_words32(words: jax.Array) -> jax.Array:
+    """uint8 (..., W) bit words -> int32 (..., ceil(W/4)) wire words.
+
+    Both layouts are LSB-first, so int32 word g is simply uint8 words
+    ``4g..4g+4`` little-endian — the transcode is shifts and ORs, never
+    a decode to bool width. Used where a packed plane meets a consumer
+    that wants 32-bit word granularity (the staircase kernel's tile
+    contraction); the mesh wire itself ships the uint8 words directly.
+    """
+    w = words.shape[-1]
+    g = -(-w // 4)
+    if g * 4 != w:
+        pad = jnp.zeros(words.shape[:-1] + (g * 4 - w,), jnp.uint8)
+        words = jnp.concatenate([words, pad], axis=-1)
+    b = words.reshape(words.shape[:-1] + (g, 4)).astype(jnp.int32)
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+
+
+def words32_to_words8(words32: jax.Array, w: int) -> jax.Array:
+    """Inverse of :func:`words8_to_words32`, trimmed to ``w`` uint8 words."""
+    parts = [
+        ((words32 >> (8 * k)) & 0xFF).astype(jnp.uint8)[..., None]
+        for k in range(4)
+    ]
+    flat = jnp.concatenate(parts, axis=-1)
+    return flat.reshape(words32.shape[:-1] + (words32.shape[-1] * 4,))[..., :w]
 
 
 def pack_flags(planes: dict) -> jax.Array:

@@ -55,12 +55,8 @@ from tpu_gossip.core.matching_topology import (
 )
 from tpu_gossip.core.state import SwarmConfig, SwarmState
 from tpu_gossip.dist._compat import shard_map_compat
-from tpu_gossip.kernels.pallas_segment import (
-    _slot_groups,
-    bernoulli_threshold_device,
-    pack_words,
-    unpack_words,
-)
+from tpu_gossip.core.packed import pack_bits, packed_width, unpack_bits
+from tpu_gossip.kernels.pallas_segment import bernoulli_threshold_device
 from tpu_gossip.kernels.permute import apply_pipeline
 
 __all__ = [
@@ -73,26 +69,31 @@ AXIS = "peers"
 
 
 def dense_wire_words(
-    plan: MatchingPlan, m: int, mode: str, forward_once: bool = False
+    plan: MatchingPlan, m: int, mode: str, forward_once: bool = False,
+    bool_planes: bool = False,
 ) -> int:
     """THE wire declaration of the matching engine: global dense all_to_all
     payload words one fault-free round of :func:`_matching_exchange_dist`
     / :func:`_matching_flood_dist` ships.
 
-    Per word group the pipeline moves one (R, 128) plane through its
-    transpose stages; the pull direction reuses the pushed plane unless
-    ``forward_once`` ships a distinct answer bitmap (mirroring
-    ``_matching_exchange_dist``). Shares its per-stage formula
+    Per byte group (one uint8 bit word of the packed codec) the pipeline
+    moves one (R, 128) byte plane through its transpose stages; the pull
+    direction reuses the pushed plane unless ``forward_once`` ships a
+    distinct answer bitmap (mirroring ``_matching_exchange_dist``).
+    Shares its per-stage formula
     (:func:`~tpu_gossip.dist.transport.matching_dense_stage_words`) with
     the traced ICI counter; the mem tier's static wire audit recomputes
     the same figure from the traced all_to_all operand shapes, so the
     declaration cannot drift from the collectives the round issues.
+
+    ``bool_planes=True`` prices the RETIRED bool wire instead (one byte
+    plane per slot, the pre-packed-native figure) — the analytic
+    reference the packed counters are quoted against (up to 8x).
     """
     from tpu_gossip.dist.transport import matching_dense_stage_words
-    from tpu_gossip.kernels.pallas_segment import _slot_groups
 
     n_stages = sum(1 for st in plan.stages if st[0] in ("t", "tinv"))
-    groups = len(_slot_groups(m))
+    groups = m if bool_planes else packed_width(m)
     if mode not in ("push", "push_pull", "flood"):
         raise ValueError(f"unknown mode {mode!r}")
     apps = 2 if (mode == "push_pull" and forward_once) else 1
@@ -151,9 +152,17 @@ def _matching_exchange_dist(
     fanout: jax.Array | None = None,
     pull_gate: jax.Array | None = None,
     pull_needy_rows: jax.Array | None = None,
+    words: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Sampled matching delivery on the mesh — the contract (and the bits)
     of ``kernels.matching.matching_sampled``.
+
+    With ``words=True`` the packed-native round hands ``transmit`` /
+    ``answer`` as (n, W) uint8 bit words (``core.packed.pack_bits``
+    layout) and the incoming product returns as words too — the pipeline
+    moves the same byte planes either way, so the only difference is
+    skipping the pack/unpack at this boundary. ``receptive_rows`` stays a
+    row-level bool mask in both forms.
 
     ``fanout``/``pull_gate`` are the adaptive controller's round decision
     (control/): the push gate recomputes from the SAME degree tables with
@@ -181,20 +190,18 @@ def _matching_exchange_dist(
         if not transport.active:
             transport = None
     s = plan.mesh_shards
-    groups = _slot_groups(m)
+    w_count = packed_width(m)
     shape = (plan.rows, 128)
     k_push, k_pull = jax.random.split(key)
 
-    tx_words = jnp.stack(
-        [pack_words(transmit[: plan.n, lo : lo + w]) for lo, w in groups],
-        axis=-1,
-    )  # (n_state, G)
-    ans_words = None
-    if do_pull and answer is not None:
-        ans_words = jnp.stack(
-            [pack_words(answer[: plan.n, lo : lo + w]) for lo, w in groups],
-            axis=-1,
-        )
+    if words:
+        tx_words = transmit[: plan.n]  # already (n_state, W) uint8
+        ans_words = answer[: plan.n] if do_pull and answer is not None else None
+    else:
+        tx_words = pack_bits(transmit[: plan.n])  # (n_state, W) uint8
+        ans_words = None
+        if do_pull and answer is not None:
+            ans_words = pack_bits(answer[: plan.n])
     # edge activation drawn once, global shape, shared across word groups —
     # bit-identical to matching_sampled's draws on the same key
     active_p = (
@@ -329,11 +336,11 @@ def _matching_exchange_dist(
                     > 0
                 )
         outs = []
-        for gi, (_, w) in enumerate(groups):
+        for gi in range(w_count):
             slot_tx = partner(
                 expand_classes(txw[:, gi], local_classes, per_rows)
             )
-            combined = jnp.zeros((per_rows, 128), jnp.int32)
+            combined = jnp.zeros((per_rows, 128), jnp.uint8)
             if act_p is not None:
                 wp = jnp.where(act_p, slot_tx, 0)
                 combined = combined | wp
@@ -351,12 +358,10 @@ def _matching_exchange_dist(
                 wq = jnp.where(act_q, slot_ans, 0)
                 combined = combined | wq
                 pull_bill = pull_bill + jax.lax.population_count(wq)
-            outs.append(
-                unpack_words(
-                    reduce_classes(combined, local_classes, n_blk, "or"), w
-                )
-            )
-        incoming = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+            outs.append(reduce_classes(combined, local_classes, n_blk, "or"))
+        incoming = jnp.stack(outs, axis=-1)  # (n_blk, W) uint8
+        if not words:
+            incoming = unpack_bits(incoming, m)
         if do_pull:
             if rec_slots is not None:
                 pull_bill = jnp.where(rec_slots, pull_bill, 0)
@@ -365,7 +370,11 @@ def _matching_exchange_dist(
 
     incoming, msgs = ex(*operands)
     if has_rec:
-        incoming = incoming & receptive_rows[:, None]
+        incoming = (
+            jnp.where(receptive_rows[:, None], incoming, jnp.uint8(0))
+            if words
+            else incoming & receptive_rows[:, None]
+        )
     return incoming, jnp.sum(msgs)
 
 
@@ -377,21 +386,22 @@ def _matching_flood_dist(
     *,
     interpret: bool | None = None,
     transport=None,
+    words: bool = False,
 ) -> jax.Array:
     """Flood delivery on the mesh — ``kernels.matching.matching_flood``
     per shard (deterministic: no gates, no billing — the engine bills
     flood off CSR degrees). ``transport`` lane-gates the transposes like
-    the sampled path (same header, same tables)."""
+    the sampled path (same header, same tables). ``words=True`` takes and
+    returns (n, W) uint8 bit words like ``_matching_exchange_dist``."""
     if transport is not None:
         transport.check_matches_plan(plan)
         if not transport.active:
             transport = None
     s = plan.mesh_shards
-    groups = _slot_groups(m)
-    tx_words = jnp.stack(
-        [pack_words(transmit[: plan.n, lo : lo + w]) for lo, w in groups],
-        axis=-1,
-    )
+    w_count = packed_width(m)
+    tx_words = (
+        transmit[: plan.n] if words else pack_bits(transmit[: plan.n])
+    )  # (n_state, W) uint8
     local_classes, per_rows, n_blk = (
         plan.local_classes, plan.per_rows, plan.n_blk,
     )
@@ -448,17 +458,14 @@ def _matching_flood_dist(
             )
 
         outs = []
-        for gi, (_, w) in enumerate(groups):
+        for gi in range(w_count):
             across = partner(
                 expand_classes(txw[:, gi], local_classes, per_rows)
             )
             across = jnp.where(valid_blk, across, 0)
-            outs.append(
-                unpack_words(
-                    reduce_classes(across, local_classes, n_blk, "or"), w
-                )
-            )
-        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+            outs.append(reduce_classes(across, local_classes, n_blk, "or"))
+        out = jnp.stack(outs, axis=-1)
+        return out if words else unpack_bits(out, m)
 
     return ex(*operands)
 
@@ -593,6 +600,13 @@ def gossip_round_dist_matching(
                 f"plan built for fanout={plan.fanout} but cfg.fanout="
                 f"{cfg.fanout}"
             )
+    from tpu_gossip.core.packed import is_packed
+
+    if is_packed(state):
+        return _gossip_round_dist_matching_packed(
+            state, cfg, plan, mesh, scenario, growth, transport,
+            collect_ici, stream, control, pipeline, liveness,
+        )
 
     def disseminate(tx, tr, rc, k_dpush, k_dpull, rctl):
         return _disseminate_matching_dist(
@@ -613,6 +627,106 @@ def gossip_round_dist_matching(
     )
     return (*out, _ici_matching(state, cfg, plan, transport, tx_eff,
                                 transmitter, receptive))
+
+
+def _gossip_round_dist_matching_packed(ps, cfg, plan, mesh, scenario, growth,
+                                       transport, collect_ici, stream,
+                                       control, pipeline, liveness):
+    """Packed-NATIVE matching round: the shared packed driver carries the
+    dispatch stages on the words, and — unlike the bucketed engine —
+    delivery itself is word-native: the transpose pipeline already moves
+    one uint8 byte plane per packed word, so the exchange takes the
+    state's words directly (``words=True``) and returns words, touching
+    no full-width plane at all on the fault-free fixed-topology path.
+    Churn re-wiring falls back to the decode-at-delivery boundary (its
+    fresh-edge scatter needs bool rows); scenario rounds decode once in
+    the shared driver like the local engine. Bit-identical to the bool
+    round (the packed dist parity tests pin it)."""
+    import types
+
+    from tpu_gossip.kernels import packed_ops as po
+    from tpu_gossip.sim.packed_engine import (
+        _decode_flags, _delivery_shim, packed_round_head,
+        run_protocol_round_packed,
+    )
+
+    m = cfg.msg_slots
+    word_native = cfg.rewire_slots == 0
+
+    def deliver_words(tx_w, role_w, flags, kp, kq, rctl):
+        if not word_native:
+            shim = _delivery_shim(ps, flags, unpack_bits(ps.seen, m))
+            role_b = unpack_bits(role_w, m)
+            inc, msgs = _disseminate_matching_dist(
+                shim, cfg, plan, mesh, unpack_bits(tx_w, m), role_b, role_b,
+                kp, kq, transport, rctl,
+            )
+            return pack_bits(inc), msgs
+        inc_w = jnp.zeros_like(ps.seen)
+        msgs = jnp.zeros((), dtype=jnp.int32)
+        if cfg.mode in ("push", "push_pull"):
+            # same splits as _disseminate_matching_dist (the rewire
+            # children are unused at rewire_slots == 0, but the parent
+            # keys the exchange draws from must match bit for bit)
+            kp, _k_rw_push = jax.random.split(kp)
+            kq, _k_rw_pull = jax.random.split(kq)
+            # word twin of kernel_path_masks at rewire_slots == 0: the
+            # pull answer ships the responder's full seen set only under
+            # forward_once (None = same plane as transmit)
+            answer_w = (
+                po.and_words(ps.seen, role_w) if cfg.forward_once else None
+            )
+            inc, n = _matching_exchange_dist(
+                plan, mesh, tx_w, answer_w, m, kp,
+                receptive_rows=po.rows_any(role_w),
+                do_push=True, do_pull=(cfg.mode == "push_pull"),
+                transport=transport,
+                fanout=None if rctl is None else rctl.m_eff,
+                pull_gate=None if rctl is None else rctl.pull_on,
+                pull_needy_rows=None if rctl is None else rctl.needy,
+                words=True,
+            )
+            inc_w = po.or_words(inc_w, inc)
+            msgs = msgs + n
+        if cfg.mode == "flood":
+            inc_w = po.or_words(inc_w, _matching_flood_dist(
+                plan, mesh, tx_w, m, transport=transport, words=True,
+            ))
+            deg = ps.row_ptr[1:] - ps.row_ptr[:-1]
+            msgs = msgs + jnp.sum(po.popcount_rows(tx_w) * deg,
+                                  dtype=jnp.int32)
+        return inc_w, msgs
+
+    def deliver_bool_factory(flags, seen_b):
+        shim = _delivery_shim(ps, flags, seen_b)
+
+        def deliver(tx, tr, rc, kp, kq, rctl):
+            return _disseminate_matching_dist(
+                shim, cfg, plan, mesh, tx, tr, rc, kp, kq, transport, rctl,
+            )
+
+        return deliver
+
+    out = run_protocol_round_packed(
+        ps, cfg, deliver_words, deliver_bool_factory, scenario=scenario,
+        growth=growth, stream=stream, control=control, pipeline=pipeline,
+        liveness=liveness,
+    )
+    if not collect_ici:
+        return out
+    # the counter's fault-free model reads transmit WITHOUT the
+    # quarantine mask (compute_roles does not apply it): head with
+    # liveness=None, decoded once for the diagnostic only
+    flags = _decode_flags(ps)
+    _, role_w, tx_w = packed_round_head(ps, cfg, flags, None)
+    if scenario is not None and scenario.has_blackout:
+        rf = scenario.at_round(ps.round + 1)
+        tx_w = po.mask_rows(tx_w, ~rf.blackout)
+    role_b = unpack_bits(role_w, m)
+    shim = types.SimpleNamespace(seen=unpack_bits(ps.seen, m),
+                                 rewired=flags["rewired"])
+    return (*out, _ici_matching(shim, cfg, plan, transport,
+                                unpack_bits(tx_w, m), role_b, role_b))
 
 
 def _ici_matching(state, cfg, plan, transport, transmit, transmitter,

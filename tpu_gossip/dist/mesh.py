@@ -520,7 +520,8 @@ def shard_swarm(state: SwarmState, mesh: Mesh) -> SwarmState:
 
 
 def dense_wire_words(
-    sg: "ShardedGraph", m: int, mode: str, forward_once: bool = False
+    sg: "ShardedGraph", m: int, mode: str, forward_once: bool = False,
+    bool_planes: bool = False,
 ) -> int:
     """THE wire declaration of the bucketed engine: global dense all_to_all
     payload words one fault-free round of :func:`_disseminate_bucketed`
@@ -533,21 +534,25 @@ def dense_wire_words(
     (analysis/mem/wire.py) recomputes the same figure from the traced
     all_to_all operand shapes — so this declaration can neither drift
     from the counter nor from the collectives the round actually issues.
+
+    ``bool_planes=True`` prices the RETIRED bool wire instead (one byte
+    per slot, the pre-packed-native figure) — the analytic reference the
+    packed counters are quoted against (~``M / ceil(M/8)`` = up to 8x).
     """
+    from tpu_gossip.core.packed import packed_width
     from tpu_gossip.dist.transport import bucketed_dense_exchange_words
-    from tpu_gossip.kernels.pallas_segment import _slot_groups
 
     s, b = sg.n_shards, sg.bucket
-    g = len(_slot_groups(m))
+    w = m if bool_planes else packed_width(m)
     if mode in ("push", "flood"):
-        return bucketed_dense_exchange_words(s, b, g)
+        return bucketed_dense_exchange_words(s, b, w)
     if mode != "push_pull":
         raise ValueError(f"unknown mode {mode!r}")
     if not forward_once:
-        # merged path: one exchange, G payload words + 1 billing word
-        return bucketed_dense_exchange_words(s, b, g + 1)
+        # merged path: one exchange, W payload bytes + 1 billing byte
+        return bucketed_dense_exchange_words(s, b, w + 1)
     # split path: a push exchange and a pull (answer) exchange
-    return 2 * bucketed_dense_exchange_words(s, b, g)
+    return 2 * bucketed_dense_exchange_words(s, b, w)
 
 
 def _exchange(
@@ -595,18 +600,19 @@ def _exchange(
     zero-adjustment controller reproduces the uncontrolled exchange bit
     for bit. The decision rides one tiny replicated (S, 2) operand.
     """
+    from tpu_gossip.core.packed import (
+        pack_bits, packed_width, unpack_bits, words8_to_words32,
+    )
     from tpu_gossip.dist.transport import (
         compact_index, gather_compact, occupancy_counts, scatter_compact,
     )
-    from tpu_gossip.kernels.pallas_segment import (
-        _slot_groups, pack_words, stream_segment_or, unpack_words,
-    )
+    from tpu_gossip.kernels.pallas_segment import _slot_groups, stream_segment_or
 
     s, b = sg.n_shards, sg.bucket
     per = sg.per_shard
     m = transmit.shape[1]
-    groups = _slot_groups(m)
-    g_count = len(groups)
+    groups = _slot_groups(m)  # 32-slot views for the staircase receive
+    w_count = packed_width(m)
     has_blocked = blocked_rows is not None
     if not has_blocked:
         blocked_rows = jnp.zeros(transmit.shape[0], dtype=bool)
@@ -658,15 +664,12 @@ def _exchange(
             pull_g = None
         send_src, recv_dst = send_src[0], recv_dst[0]  # (S, B)
         valid, dst_deg, src_deg = valid[0], dst_deg[0], src_deg[0]
-        # pack ONCE at node granularity, then ONE per-edge gather of G int32
-        # words (the old path gathered M bools per edge per direction and
-        # deg[send_src] besides — 3x the random access, 4x the ICI bytes at
-        # m=16)
-        words = jnp.stack(
-            [pack_words(transmit_blk[:, lo : lo + w]) for lo, w in groups],
-            axis=-1,
-        )  # (per, G)
-        vals = words[send_src]  # (S, B, G) — THE send-side gather
+        # pack ONCE at node granularity into the codec's uint8 bit words,
+        # then ONE per-edge gather of W bytes (the int32 slot-group wire
+        # before this shipped 4-byte words even at m=16 — 1 occupied byte
+        # in 4; the byte wire ships exactly the codec's resident bytes)
+        words = pack_bits(transmit_blk)  # (per, W) uint8
+        vals = words[send_src]  # (S, B, W) — THE send-side gather
         if activation == "flood":
             payload = jnp.where(valid[:, :, None], vals, 0)
         elif activation == "push":
@@ -695,8 +698,8 @@ def _exchange(
             if pull_g is not None:
                 act_q = act_q & pull_g
             payload = jnp.where((act_p | act_q)[:, :, None], vals, 0)
-            # per-direction billing rides two word bits alongside the words
-            acts = act_p.astype(jnp.int32) | (act_q.astype(jnp.int32) << 1)
+            # per-direction billing rides two bits in one extra byte
+            acts = act_p.astype(jnp.uint8) | (act_q.astype(jnp.uint8) << 1)
             payload = jnp.concatenate([payload, acts[:, :, None]], axis=-1)
         if not sparse_on:
             received = jax.lax.all_to_all(
@@ -736,8 +739,8 @@ def _exchange(
 
             received = jax.lax.cond(fits, compact_lane, dense_lane)
         if merged:
-            acts_r = received[:, :, g_count]
-            received = received[:, :, :g_count]
+            acts_r = received[:, :, w_count]
+            received = received[:, :, :w_count]
         # receiver-side stale filter BEFORE counting (stale deliveries are
         # neither delivered nor billed, like the local engine's edge masks);
         # the per-edge blocked gather only exists under churn re-wiring
@@ -763,12 +766,9 @@ def _exchange(
             )
         else:
             msgs = jnp.sum(pc(received), dtype=jnp.int32)
-        flat = received.reshape(s * b, g_count)
+        flat = received.reshape(s * b, w_count)
         if shard_plan is None:
-            bits = jnp.concatenate(
-                [unpack_words(flat[:, gi], w) for gi, (_, w) in enumerate(groups)],
-                axis=1,
-            )
+            bits = unpack_bits(flat, m)
             incoming = (
                 jnp.zeros((per, m), dtype=bool)
                 .at[recv_dst.reshape(-1)]
@@ -776,11 +776,15 @@ def _exchange(
             )
         else:
             # zero-gather receive: dest-sorted runs stream straight into the
-            # windowed staircase kernel (pallas_segment.stream_segment_or)
+            # windowed staircase kernel (pallas_segment.stream_segment_or).
+            # The kernel consumes int32 slot-group columns; the LSB-first
+            # byte→word32 transcode is exact on the 32-aligned groups, so
+            # the byte wire feeds it without re-deriving from bools.
+            flat32 = words8_to_words32(flat)  # (s*b, G) int32
             outs = [
                 stream_segment_or(
                     plan_blks[0][0], plan_blks[1][0], plan_blks[3][0],
-                    plan_blks[2][0], flat[:, gi], w,
+                    plan_blks[2][0], flat32[:, gi], w,
                     n=per, n_tiles=shard_plan.n_tiles,
                     n_blocks=shard_plan.n_blocks, rows=shard_plan.rows,
                     interpret=None,
@@ -994,6 +998,13 @@ def gossip_round_dist(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
             f"{mesh.size} devices — repartition with partition_graph(g, {mesh.size})"
         )
+    from tpu_gossip.core.packed import is_packed
+
+    if is_packed(state):
+        return _gossip_round_dist_packed(
+            state, cfg, sg, mesh, shard_plan, scenario, growth, transport,
+            collect_ici, stream, control, pipeline, liveness,
+        )
 
     def disseminate(tx, tr, rc, k_dpush, k_dpull, rctl):
         return _disseminate_bucketed(
@@ -1017,14 +1028,88 @@ def gossip_round_dist(
                                 transmitter))
 
 
+def _gossip_round_dist_packed(ps, cfg, sg, mesh, shard_plan, scenario, growth,
+                              transport, collect_ici, stream, control,
+                              pipeline, liveness):
+    """Packed-NATIVE bucketed round: the shared packed driver
+    (sim/packed_engine.run_protocol_round_packed) carries every dispatch
+    stage on the words; the bucketed CSR exchange is the one stage that
+    genuinely needs full width (its per-edge bucket gather and receive
+    scatter index slot ROWS of the bool plane), so delivery decodes the
+    round's transmit/role planes once at this boundary — the exchange
+    itself re-packs per shard block and ships the byte wire either way —
+    and packs the incoming product back. Bit-identical to the bool round
+    (the packed dist parity tests pin it)."""
+    from tpu_gossip.core.packed import pack_bits, packed_width, unpack_bits
+    from tpu_gossip.dist.transport import ici_round_bucketed
+    from tpu_gossip.kernels import packed_ops as po
+    from tpu_gossip.sim.packed_engine import (
+        _decode_flags, _delivery_shim, packed_round_head,
+        run_protocol_round_packed,
+    )
+
+    m = cfg.msg_slots
+
+    def deliver_words(tx_w, role_w, flags, kp, kq, rctl):
+        shim = _delivery_shim(ps, flags, unpack_bits(ps.seen, m))
+        role_b = unpack_bits(role_w, m)
+        inc, msgs = _disseminate_bucketed(
+            shim, cfg, sg, mesh, shard_plan, unpack_bits(tx_w, m), role_b,
+            role_b, kp, kq, transport, rctl,
+        )
+        return pack_bits(inc), msgs
+
+    def deliver_bool_factory(flags, seen_b):
+        shim = _delivery_shim(ps, flags, seen_b)
+
+        def deliver(tx, tr, rc, kp, kq, rctl):
+            return _disseminate_bucketed(
+                shim, cfg, sg, mesh, shard_plan, tx, tr, rc, kp, kq,
+                transport, rctl,
+            )
+
+        return deliver
+
+    out = run_protocol_round_packed(
+        ps, cfg, deliver_words, deliver_bool_factory, scenario=scenario,
+        growth=growth, stream=stream, control=control, pipeline=pipeline,
+        liveness=liveness,
+    )
+    if not collect_ici:
+        return out
+    # word-native twin of effective_transmit_planes + _ici_bucketed: the
+    # counter's fault-free model reads transmit WITHOUT the quarantine
+    # mask (compute_roles does not apply it), so the head runs with
+    # liveness=None; row indicators come straight off the words
+    flags = _decode_flags(ps)
+    _, role_w, tx_w = packed_round_head(ps, cfg, flags, None)
+    if scenario is not None and scenario.has_blackout:
+        rf = scenario.at_round(ps.round + 1)
+        tx_w = po.mask_rows(tx_w, ~rf.blackout)
+    nbytes = packed_width(m)
+    rewiring = cfg.rewire_slots > 0 and cfg.mode in ("push", "push_pull")
+    merged = cfg.mode == "push_pull" and not cfg.forward_once
+    tx_any = po.rows_any(tx_w)
+    ans_any = None
+    if cfg.mode != "flood":
+        if rewiring:
+            tx_any = tx_any & ~flags["rewired"]
+        if cfg.mode == "push_pull" and not merged:
+            ans_any = po.rows_any(po.and_words(ps.seen, role_w))
+            if rewiring:
+                ans_any = ans_any & ~flags["rewired"]
+    return (*out, ici_round_bucketed(sg, transport, nbytes, tx_any, ans_any,
+                                     merged))
+
+
 def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
     """The analytic counter's view of one bucketed round: the same plane
     masks ``_disseminate_bucketed`` applies, reduced to per-row
     nonzero-word indicators."""
+    from tpu_gossip.core.packed import packed_width
     from tpu_gossip.dist.transport import ici_round_bucketed
-    from tpu_gossip.kernels.pallas_segment import _slot_groups
 
-    n_words = len(_slot_groups(cfg.msg_slots))
+    nbytes = packed_width(cfg.msg_slots)
     rewiring = cfg.rewire_slots > 0 and cfg.mode in ("push", "push_pull")
     merged = cfg.mode == "push_pull" and not cfg.forward_once
     tx_any = transmit.any(-1)
@@ -1036,7 +1121,7 @@ def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
             ans_any = (state.seen & transmitter).any(-1)
             if rewiring:
                 ans_any = ans_any & ~state.rewired
-    return ici_round_bucketed(sg, transport, n_words, tx_any, ans_any, merged)
+    return ici_round_bucketed(sg, transport, nbytes, tx_any, ans_any, merged)
 
 
 @functools.partial(
@@ -1074,27 +1159,22 @@ def simulate_dist(
     per-round analytic ICI word trajectory stacked alongside the stats.
     ``stream`` threads a compiled streaming workload (traffic/) exactly
     as in the local engine. A :class:`~tpu_gossip.core.packed.
-    PackedSwarm` input keeps the scan CARRY packed (the sharded resident
-    state between rounds is the registry's packed storage ledger) while
-    each round runs unpack -> the identical mesh round -> repack — the
-    pack is row-parallel, so the packed pytree keeps the peer-axis
-    sharding and the packed mesh trajectory is bit-identical to the
-    unpacked one (and, transitively, to the local engine's).
+    PackedSwarm` input runs packed-NATIVE end to end:
+    ``gossip_round_dist`` dispatches it to the packed round driver, the
+    scan carry IS the packed pytree (peer-axis sharding preserved), and
+    no full-width state round-trip survives between rounds — the packed
+    mesh trajectory stays bit-identical to the unpacked one (and,
+    transitively, to the local engine's).
     """
-    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
-
-    packed = is_packed(state)
 
     def body(carry, _):
-        st = unpack_state(carry) if packed else carry
-        out = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
+        out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
                                 scenario, growth, transport, collect_ici,
                                 stream, control, pipeline, liveness)
         if collect_ici:
             nxt, stats, ici = out
-            return (pack_state(nxt) if packed else nxt), (stats, ici)
-        nxt, stats = out
-        return (pack_state(nxt) if packed else nxt), stats
+            return nxt, (stats, ici)
+        return out
 
     return jax.lax.scan(body, state, None, length=num_rounds)
 
@@ -1139,10 +1219,6 @@ def run_until_coverage_dist(
     """
     from tpu_gossip.dist.transport import accumulate_ici, zero_ici_totals
 
-    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
-
-    packed = is_packed(state)
-
     def cond_plain(st) -> jax.Array:
         # PackedSwarm reads coverage off its packed words (one bit
         # column); the definition matches SwarmState.coverage exactly
@@ -1151,12 +1227,11 @@ def run_until_coverage_dist(
     if not collect_ici:
 
         def body(st):
-            nxt, _ = gossip_round_dist(unpack_state(st) if packed else st,
-                                       cfg, sg, mesh, shard_plan,
+            nxt, _ = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                        scenario, growth, transport,
                                        stream=stream, control=control,
                                        pipeline=pipeline, liveness=liveness)
-            return pack_state(nxt) if packed else nxt
+            return nxt
 
         return jax.lax.while_loop(cond_plain, body, state)
 
@@ -1165,10 +1240,9 @@ def run_until_coverage_dist(
 
     def body_ici(carry):
         st, acc = carry
-        nxt, _, ici = gossip_round_dist(unpack_state(st) if packed else st,
-                                        cfg, sg, mesh, shard_plan,
+        nxt, _, ici = gossip_round_dist(st, cfg, sg, mesh, shard_plan,
                                         scenario, growth, transport, True,
                                         stream, control, pipeline, liveness)
-        return (pack_state(nxt) if packed else nxt), accumulate_ici(acc, ici)
+        return nxt, accumulate_ici(acc, ici)
 
     return jax.lax.while_loop(cond, body_ici, (state, zero_ici_totals()))

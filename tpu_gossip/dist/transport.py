@@ -648,23 +648,27 @@ def _build_matching_transport(
 # recomputes the same figures from the traced all_to_all operand shapes,
 # so a hand-edit here that drifts from what the engines actually ship —
 # or an engine change that silently grows the wire — fails CI.
-def bucketed_dense_exchange_words(s: int, b: int, gp: int) -> int:
-    """Global dense words of ONE bucketed exchange: each of ``s`` shards
-    ships its (S, B, gp) payload (``gp`` int32 words per bucket entry —
-    the packed word groups, +1 billing word on the merged push_pull
-    path)."""
-    return s * s * b * gp
+def bucketed_dense_exchange_words(s: int, b: int, nbytes: int) -> int:
+    """Global dense 4-byte words of ONE bucketed exchange: each of ``s``
+    shards ships its (S, B, nbytes) uint8 payload — the packed bit-word
+    bytes straight off the codec layout (``core.packed.pack_bits``), +1
+    billing byte on the merged push_pull path. The per-shard operand
+    rounds up to whole words exactly like the traced-wire audit's
+    ``_aval_words`` (analysis/mem/wire.py), so declaration and audit
+    agree byte for byte."""
+    return s * (-(-(s * b * nbytes) // 4))
 
 
 def matching_dense_stage_words(rows: int) -> int:
-    """Global dense words of ONE matching transpose stage: every shard
-    ships its (per, 128) int32 block — together the full (R, 128)
-    plane."""
-    return rows * 128
+    """Global dense 4-byte words of ONE matching transpose stage: every
+    shard ships its (per, 128) uint8 byte-plane block — together one full
+    (R, 128) byte plane (was rows*128 words when the wire carried int32
+    slot-group words; the packed wire ships the codec bytes)."""
+    return rows * 32
 
 
 def ici_round_bucketed(
-    sg, transport: "Transport | None", n_words: int, tx_any: jax.Array,
+    sg, transport: "Transport | None", nbytes: int, tx_any: jax.Array,
     ans_any: jax.Array | None, merged: bool,
 ) -> IciRound:
     """Analytic ICI words for one bucketed round (fault-free model).
@@ -674,31 +678,35 @@ def ici_round_bucketed(
     on the split push_pull path), already stale-masked by the caller
     exactly as ``_disseminate_bucketed`` masks them. Pre-activation
     occupancy is the same quantity the runtime gate reads, so the
-    reported lane choice IS the executed one.
+    reported lane choice IS the executed one. ``nbytes`` is the packed
+    payload width per bucket entry (``packed_width(msg_slots)``); the
+    merged push_pull path rides one extra billing byte. The compact lane
+    ships one int32 index word per slot plus the uint8 payload rounded up
+    to whole words per shard — mirroring ``gather_compact``'s traced
+    operands.
     """
     s, b, per = sg.n_shards, sg.bucket, sg.per_shard
     srcg = sg.send_src + (jnp.arange(s, dtype=jnp.int32) * per)[:, None, None]
 
-    def one(plane_any, gp):
+    def one(plane_any, nb):
         occ = sg.send_valid & plane_any[srcg]
         counts = jnp.sum(occ, axis=-1, dtype=jnp.int32)  # (S, S)
-        dense = jnp.int32(bucketed_dense_exchange_words(s, b, gp))
-        occupied = jnp.sum(counts) * gp
+        dense = jnp.int32(bucketed_dense_exchange_words(s, b, nb))
+        occupied = (jnp.sum(counts) * nb + 3) // 4
         if transport is None or not transport.active:
             return IciRound(dense, dense, occupied, jnp.int32(0), jnp.int32(0))
         cap = transport.budget
         header = jnp.int32(s * s)
         fit = jnp.max(counts) <= cap
-        shipped = jnp.where(
-            fit, jnp.int32(s * s * cap * (gp + 1)) + header, dense + header
-        )
+        compact = jnp.int32(s * s * cap + s * (-(-(s * cap * nb) // 4)))
+        shipped = jnp.where(fit, compact + header, dense + header)
         return IciRound(
             dense, shipped, occupied, fit.astype(jnp.int32), jnp.int32(1)
         )
 
-    out = one(tx_any, n_words + 1 if merged else n_words)
+    out = one(tx_any, nbytes + 1 if merged else nbytes)
     if ans_any is not None:
-        out = _add_ici(out, one(ans_any, n_words))
+        out = _add_ici(out, one(ans_any, nbytes))
     return out
 
 
@@ -708,25 +716,26 @@ def ici_round_matching(
 ) -> IciRound:
     """Analytic ICI words for one matching round's transpose passes.
 
-    Per word group the pipeline moves one (R, 128) plane through
-    ``len(hub_tables)`` transpose collectives (the pull direction reuses
-    the push plane unless forward_once ships a distinct answer bitmap —
-    mirroring ``_matching_exchange_dist``). Occupied words are the plane's
-    nonzero slot count — conserved by the permutation, so it is exact at
-    every stage; the shipped figure uses the static lane shapes plus the
-    leaf index plane, gated per group by the same conserved count the
-    runtime header psums. All figures count the GLOBAL wire — every
-    shard's send summed, matching ``dense_stage = rows * 128`` (each of S
-    shards ships its (per, 128) block) — so the compact lane charges
-    S x ((H + cap) x 128) payload plus the S x (S, cap) index planes.
+    Per byte group the pipeline moves one (R, 128) uint8 byte plane
+    through ``len(hub_tables)`` transpose collectives (the pull direction
+    reuses the push plane unless forward_once ships a distinct answer
+    bitmap — mirroring ``_matching_exchange_dist``). Occupied words are
+    the plane's nonzero slot count in bytes, rounded up to words —
+    conserved by the permutation, so it is exact at every stage; the
+    shipped figure uses the static lane shapes plus the leaf index plane,
+    gated per group by the same conserved count the runtime header psums.
+    All figures count the GLOBAL wire — every shard's send summed,
+    matching ``dense_stage = rows * 32`` (each of S shards ships its
+    (per, 128) uint8 block) — so the compact lane charges
+    S x ((H + cap) x 128) payload bytes plus the S x (S, cap) int32 index
+    planes.
     """
     from tpu_gossip.core.matching_topology import expand_classes
-    from tpu_gossip.kernels.pallas_segment import _slot_groups
 
     r = plan.rows
     s = plan.mesh_shards
     per = r // s
-    groups = _slot_groups(m)
+    groups = [(lo, min(8, m - lo)) for lo in range(0, m, 8)]
     if transport is not None and transport.active:
         n_stages = len(transport.hub_tables)
         hub_rows = tuple(t.shape[1] for t in transport.hub_tables)
@@ -744,7 +753,7 @@ def ici_round_matching(
             slots = expand_classes(nzn, plan.classes, r)  # (R, 128) 0/1
             nz = jnp.sum(slots, dtype=jnp.int32)
             dense = dense_stage * n_stages
-            occupied = nz * n_stages
+            occupied = (nz * n_stages + 3) // 4
             if transport is None or not transport.active:
                 total = _add_ici(
                     total,
@@ -761,7 +770,7 @@ def ici_round_matching(
                     shipped = shipped + dense_stage
                     continue
                 take = take_leaf if sm == "hub" else take_total
-                compact = jnp.int32(s * (h + cap) * 128 + s * s * cap)
+                compact = jnp.int32(s * (h + cap) * 32 + s * s * cap)
                 shipped = shipped + jnp.where(take, compact, dense_stage)
                 taken = taken + take.astype(jnp.int32)
                 lanes += 1

@@ -1,0 +1,157 @@
+"""Word-level bit-plane algebra for packed-native rounds.
+
+PR 15 packed the boolean message planes into LSB-first uint8 words
+(core/packed.py); until now every round still unpacked them back to full
+width, so the codec transient *was* the per-round memory spike. This
+module is the packed-native replacement: the delivery merge, the stale
+filter, the forward-once latch, and every infection/duplicate counter
+run directly on the ``(N, W)`` words — OR/AND/ANDN plus
+``jax.lax.population_count`` — and the full-width bool planes only ever
+exist where an op genuinely needs them (the XLA push scatter, stream
+injection, control feedback), decoded through ``core.packed`` at that
+boundary.
+
+Placement is load-bearing: the ``deep-transient-liveness`` taint rail
+(analysis/deep/liveness.py) sanctions word-level compute on packed
+planes only inside the kernel tier (``kernels/``, ``dist/``, the
+matching topology) and keeps decode-to-bool-width licensed solely in
+``core/packed.py`` — so the word equations live *here*, not in
+``sim/engine.py``, and the rail can keep flagging stray full-width
+transients elsewhere.
+
+Two invariants every helper preserves (docs/memory_budget.md):
+
+- **padding-always-zero**: bits ``m..8W`` of every plane stay clear, so
+  OR/AND of conforming planes conforms and popcounts are exact with no
+  ragged-tail mask;
+- **NOT always masks**: bitwise negation is the one op that can
+  manufacture padding ones, so it is only ever spelled
+  ``~w & word_mask(m)`` (``not_words``/``andnot_words``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.packed import word_mask
+
+__all__ = [
+    "or_words",
+    "and_words",
+    "andnot_words",
+    "not_words",
+    "mask_rows",
+    "mask_cols",
+    "rows_any",
+    "popcount_rows",
+    "popcount_cols",
+    "count_bits",
+    "role_words",
+    "pull_words",
+    "gather_or_words",
+]
+
+
+def or_words(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Word-level delivery merge: ``a | b`` (conforming planes conform)."""
+    return a | b
+
+
+def and_words(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Word-level intersection: ``a & b``."""
+    return a & b
+
+
+def andnot_words(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a & ~b`` — the forward-once / stale-filter latch on words.
+
+    Padding-safe without a mask: ``~b`` flips the padding bits on, but
+    ``a`` honors padding-always-zero, so the AND clears them again.
+    """
+    return a & ~b
+
+
+def not_words(a: jax.Array, m: int) -> jax.Array:
+    """``~a`` with the ragged-tail padding bits re-cleared."""
+    return ~a & word_mask(m)
+
+
+def mask_rows(words: jax.Array, rows: jax.Array) -> jax.Array:
+    """Zero whole rows: ``words & rows[:, None]`` with a bool row mask.
+
+    Spelled as a select (structural under the taint rail) so row-level
+    gating never counts as word compute anywhere it appears.
+    """
+    return jnp.where(rows[:, None], words, jnp.uint8(0))
+
+
+def mask_cols(words: jax.Array, col_words: jax.Array) -> jax.Array:
+    """AND a per-slot column mask, itself packed: ``words & col_words``.
+
+    ``col_words`` is a conforming ``(W,)`` plane (e.g. ``pack_bits`` of
+    ``~expired`` — pack after NOT, so padding stays zero).
+    """
+    return words & col_words[None, :]
+
+
+def rows_any(words: jax.Array) -> jax.Array:
+    """Bool (N,): row has any bit set — occupancy straight off the words."""
+    return (words != jnp.uint8(0)).any(axis=-1)
+
+
+def popcount_rows(words: jax.Array) -> jax.Array:
+    """int32 (...,): per-row set-bit count, exact thanks to zero padding.
+
+    Bit-identical to ``bools.sum(-1, dtype=int32)`` on the unpacked
+    plane — the popcount replacement for every full-width boolean sum.
+    """
+    return jnp.sum(
+        jax.lax.population_count(words), axis=-1, dtype=jnp.int32
+    )
+
+
+def popcount_cols(words: jax.Array) -> jax.Array:
+    """int32 (W,): per-word-column set-bit totals (slot-granular stats
+    still decode the column they need via ``bit_column``)."""
+    return jnp.sum(
+        jax.lax.population_count(words), axis=0, dtype=jnp.int32
+    )
+
+
+def count_bits(words: jax.Array) -> jax.Array:
+    """int32 scalar: total set bits across the plane."""
+    return jnp.sum(jax.lax.population_count(words), dtype=jnp.int32)
+
+
+def role_words(recovered_w: jax.Array, active: jax.Array, m: int) -> jax.Array:
+    """Word twin of ``compute_roles``' (N, M) masks.
+
+    ``active[:, None] & ~recovered`` on words: transmitter and receptive
+    are the same plane in the bool engine, so one call serves both.
+    """
+    return mask_rows(not_words(recovered_w, m), active)
+
+
+def pull_words(answer_w: jax.Array, targets: jax.Array, valid: jax.Array) -> jax.Array:
+    """Word twin of ``pull_fanout``: gather each peer's K partners'
+    answer words and OR-reduce them.
+
+    ``targets`` int32 (N, K), ``valid`` bool (N, K). Pure gather + OR —
+    no scatter — so the pull half of push-pull never touches full width.
+    """
+    got = jnp.where(valid[:, :, None], answer_w[targets], jnp.uint8(0))
+    return jax.lax.reduce(
+        got, np.uint8(0), jax.lax.bitwise_or, dimensions=(1,)
+    )
+
+
+def gather_or_words(words: jax.Array, idx: jax.Array, valid: jax.Array) -> jax.Array:
+    """OR-reduce a gathered word set per row: the reverse-fresh-push and
+    matching-permutation merge primitive (``words[idx]`` masked by
+    ``valid`` then OR-folded over the gather axis)."""
+    got = jnp.where(valid[..., None], words[idx], jnp.uint8(0))
+    return jax.lax.reduce(
+        got, np.uint8(0), jax.lax.bitwise_or, dimensions=(got.ndim - 2,)
+    )

@@ -1054,8 +1054,25 @@ def gossip_round(
     ``liveness=None`` — and, with at least one live witness,
     ``quorum_k=1`` under no adversaries — reproduce the historical
     detector's trajectory bit for bit.
+
+    A :class:`~tpu_gossip.core.packed.PackedSwarm` input runs the
+    packed-NATIVE round (``sim.packed_engine``): the hot stages compute
+    directly on the uint8 bit words and full width exists only at the
+    ops that genuinely need it (the push scatter, stream injection,
+    control feedback, the scenario head). Bit-identical to this bool
+    round — same RNG sequence, same stats — and returns a packed state.
     """
+    from tpu_gossip.core.packed import is_packed
     from tpu_gossip.sim.stages import run_protocol_round
+
+    if is_packed(state):
+        from tpu_gossip.sim.packed_engine import gossip_round_packed
+
+        return gossip_round_packed(
+            state, cfg, plan, tail=tail, scenario=scenario, growth=growth,
+            stream=stream, control=control, pipeline=pipeline,
+            liveness=liveness,
+        )
 
     def disseminate(tx, tr, rc, kp, kq, rctl):
         return _disseminate_local(state, cfg, tx, tr, rc, kp, kq, plan, rctl)
@@ -1098,25 +1115,20 @@ def simulate(
     (sim.metrics.reliability_report consumes it).
 
     PACKED runs: pass a :class:`~tpu_gossip.core.packed.PackedSwarm`
-    (``pack_state(state)``) and the scan CARRY stays packed — the
-    resident inter-round state is the registry's packed storage ledger
-    (67 B/peer at m=16 vs 142 unpacked) — while each round body runs
-    unpack -> the identical round program -> repack, so the packed
-    trajectory is bit-identical to the unpacked one (test-pinned across
-    the composed scenario×growth×stream×control×pipeline×adversary
-    matrix). The return is packed too; ``unpack_state`` reads it.
+    (``pack_state(state)``) and the whole scan is packed-NATIVE — the
+    carry is the registry's packed storage ledger (67 B/peer at m=16 vs
+    142 unpacked) and the round body computes on the bit words
+    (``sim.packed_engine``), decoding only at the ops that genuinely
+    need full width. The packed trajectory is bit-identical to the
+    unpacked one (test-pinned across the composed
+    scenario×growth×stream×control×pipeline×adversary matrix). The
+    return is packed too; ``unpack_state`` reads it.
     """
-    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
-
-    packed = is_packed(state)
 
     def body(carry, _):
-        nxt, stats = gossip_round(unpack_state(carry) if packed else carry,
-                                  cfg, plan, tail=tail, scenario=scenario,
-                                  growth=growth, stream=stream,
-                                  control=control, pipeline=pipeline,
-                                  liveness=liveness)
-        return (pack_state(nxt) if packed else nxt), stats
+        return gossip_round(carry, cfg, plan, tail=tail, scenario=scenario,
+                            growth=growth, stream=stream, control=control,
+                            pipeline=pipeline, liveness=liveness)
 
     return jax.lax.scan(body, state, None, length=num_rounds)
 
@@ -1160,24 +1172,20 @@ def run_until_coverage(
     fixed-horizon :func:`simulate` instead (the CLI enforces this).
 
     PACKED runs (see :func:`simulate`): a
-    :class:`~tpu_gossip.core.packed.PackedSwarm` input keeps the while
-    CARRY packed; the predicate reads coverage straight off the packed
+    :class:`~tpu_gossip.core.packed.PackedSwarm` input runs the loop
+    packed-NATIVE; the predicate reads coverage straight off the packed
     words (``PackedSwarm.coverage`` — one bit column, no plane unpack)
-    and the body runs unpack -> round -> repack, bit-identical to the
-    unpacked loop.
+    and the body is the word-level round (``sim.packed_engine``),
+    bit-identical to the unpacked loop.
     """
-    from tpu_gossip.core.packed import is_packed, pack_state, unpack_state
-
-    packed = is_packed(state)
 
     def cond(s) -> jax.Array:
         return (s.coverage(slot) < target) & (s.round - state.round < max_rounds)
 
     def body(s):
-        nxt, _ = gossip_round(unpack_state(s) if packed else s, cfg, plan,
-                              tail=tail, scenario=scenario, growth=growth,
-                              stream=stream, control=control,
+        nxt, _ = gossip_round(s, cfg, plan, tail=tail, scenario=scenario,
+                              growth=growth, stream=stream, control=control,
                               pipeline=pipeline, liveness=liveness)
-        return pack_state(nxt) if packed else nxt
+        return nxt
 
     return jax.lax.while_loop(cond, body, state)
