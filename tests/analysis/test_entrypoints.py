@@ -48,6 +48,9 @@ def test_matrix_structural_coverage():
     assert "local[matching,scenario]" in names
     assert "local[matching,growth]" in names and "local[pallas,growth]" in names
     assert "local[matching,stream]" in names and "local[pallas,stream]" in names
+    # the SERVED round (serve/ live-ingestion window) on every engine
+    assert "local[matching,ingest]" in names and "local[pallas,ingest]" in names
+    assert "local[xla,ingest]" in names
     assert "local[matching,control]" in names and "local[pallas,control]" in names
     assert "local[simulate]" in names and "local[run_until_coverage]" in names
     # the PACKED loop entries (core/packed.py): packed carries must be
@@ -60,7 +63,7 @@ def test_matrix_structural_coverage():
     assert {"dist-matching", "dist-bucketed"} <= engines
     for n in (
         "dist[matching]", "dist[matching,scenario]", "dist[matching,growth]",
-        "dist[matching,stream]",
+        "dist[matching,stream]", "dist[matching,ingest]",
         "dist[bucketed]", "dist[bucketed,growth]", "dist[bucketed,stream]",
         "dist[matching,simulate]", "dist[bucketed,run_until_coverage]",
         "dist[matching,sparse]", "dist[bucketed,sparse]",

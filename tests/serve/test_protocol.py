@@ -1,0 +1,147 @@
+"""Serving-protocol conformance: literal reference wire strings in,
+typed events out (PARITY.md "Serving live clients").
+
+The frontend's parse is pinned against the reference's EXACT framing —
+the same literal lines ``tests/compat/test_wire.py`` pins the codecs
+with — plus the serving dispositions layered on top (register / gossip
+/ query) and total-parse behavior on malformed lines (the latent
+reference bug: its reader thread dies in ``ast.literal_eval``,
+reference Peer.py:194-199)."""
+
+import random
+
+import pytest
+
+from tpu_gossip.compat import wire
+from tpu_gossip.core.state import message_slots
+from tpu_gossip.serve import parse_line, payload_hash64, slots_for_payload
+from tpu_gossip.serve.protocol import encode_query, encode_query_reply
+
+ADDR = ("127.0.0.1", 5000)
+
+SERVE_KINDS = {
+    "empty", "ping", "seed_handshake", "heartbeat", "dead_node",
+    "new_node_update", "malformed", "register", "gossip", "query",
+}
+
+
+# --- literal reference wire strings ----------------------------------------
+
+@pytest.mark.parametrize(
+    "line,kind",
+    [
+        ("PING", "ping"),
+        ("I am seed|('127.0.0.1', 5000)", "seed_handshake"),
+        ("Heartbeat from ('127.0.0.1', 5000)", "heartbeat"),
+        ("Dead Node: ('127.0.0.1', 5000)", "dead_node"),
+        ("NewNodeUpdate|('a', 1)|[('b', 2)]", "new_node_update"),
+        ("('127.0.0.1', 5000)", "register"),  # bare handshake (Peer.py:95-97)
+        ("QUERY coverage", "query"),
+        ("2025-01-01 00:00:00:127.0.0.1:5000:3", "gossip"),
+        ("hello world", "gossip"),  # unknown text disseminates as-is
+        ("", "empty"),
+        ("Heartbeat from not-an-addr", "malformed"),
+        ("Dead Node: 42", "malformed"),
+        ("NewNodeUpdate|('a',1)|5", "malformed"),
+    ],
+)
+def test_parse_line_literal_strings(line, kind):
+    ev = parse_line(line)
+    assert ev.kind == kind
+    assert ev.kind in SERVE_KINDS
+
+
+def test_register_carries_decoded_addr():
+    ev = parse_line(wire.encode_peer_handshake(ADDR))
+    assert ev.kind == "register" and ev.payload == ADDR
+
+
+def test_heartbeat_carries_decoded_addr():
+    ev = parse_line(wire.encode_heartbeat(ADDR))
+    assert ev.kind == "heartbeat" and ev.payload == ADDR
+
+
+def test_gossip_event_identity_is_wire_message_id():
+    raw = wire.encode_gossip("2025-01-01 00:00:00", "10.0.0.1", 6000, 7)
+    ev = parse_line(raw)
+    assert ev.kind == "gossip"
+    assert ev.message_id == wire.gossip_message_id(raw.decode())
+    assert ev.payload_hash == payload_hash64(ev.message_id)
+
+
+def test_query_strips_prefix_and_frames_reply():
+    ev = parse_line(encode_query("liveness"))
+    assert ev.kind == "query" and ev.payload == "liveness"
+    reply = encode_query_reply('{"a": 1,\n "b": 2}')
+    assert reply.endswith(b"\n") and reply.count(b"\n") == 1
+
+
+def test_malformed_lines_never_raise():
+    # total parse: the frontend's reader loop survives any bytes
+    for raw in (b"\xff\xfe garbage", b"Heartbeat from ('x',",
+                b"I am seed|[[[", b"\x00" * 64, "Dead Node: ".encode()):
+        assert parse_line(raw).kind in SERVE_KINDS
+
+
+# --- property round-trips (seeded; hypothesis is not in the image) ---------
+
+def test_gossip_roundtrip_property():
+    rng = random.Random(0)
+    for _ in range(300):
+        ts = f"2025-01-01 00:00:{rng.randrange(60):02d}"
+        ip = ".".join(str(rng.randrange(256)) for _ in range(4))
+        port, count = rng.randrange(1, 65536), rng.randrange(10**6)
+        raw = wire.encode_gossip(ts, ip, port, count)
+        ev = parse_line(raw)
+        assert ev.kind == "gossip"
+        assert ev.message_id == raw.decode().strip()
+        # the hash is a pure function of the dedup identity
+        assert ev.payload_hash == parse_line(raw).payload_hash
+
+
+def test_wire_framing_records_roundtrip_property():
+    # every reference framing record round-trips through parse_line with
+    # its wire kind preserved (the PARITY framing catalog)
+    rng = random.Random(1)
+    for _ in range(200):
+        addr = (f"10.{rng.randrange(256)}.{rng.randrange(256)}.1",
+                rng.randrange(1, 65536))
+        assert parse_line(wire.encode_heartbeat(addr)).payload == addr
+        assert parse_line(wire.encode_dead_node(addr)).payload == addr
+        assert parse_line(wire.encode_seed_handshake(addr)).payload == addr
+        assert parse_line(wire.encode_peer_handshake(addr)).payload == addr
+        assert parse_line(wire.encode_ping()).kind == "ping"
+
+
+def test_parse_total_on_random_bytes():
+    rng = random.Random(2)
+    for _ in range(300):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+        assert parse_line(blob).kind in SERVE_KINDS
+
+
+# --- the hash → slot contract (live == replay by construction) -------------
+
+def test_payload_hash64_is_fnv1a_64():
+    # pinned constants: changing them would silently break every recorded
+    # trace's replay
+    assert payload_hash64("") == 0xCBF29CE484222325
+    assert payload_hash64("a") == 0xAF63DC4C8601EC8C
+
+
+def test_slots_for_payload_matches_message_slots():
+    rng = random.Random(3)
+    for _ in range(100):
+        h = rng.getrandbits(64)
+        m = rng.choice([4, 8, 16, 32])
+        k = rng.randrange(1, min(m, 4) + 1)
+        assert slots_for_payload(h, m, k) == message_slots(h, m, k)
+
+
+def test_slot_draw_agrees_across_the_socket_boundary():
+    # a gossip line hashed live maps to the same slots as its recorded
+    # trace integer does in replay — the bit-identity hinge
+    raw = wire.encode_gossip("2025-01-01 00:00:00", "10.0.0.1", 6000, 7)
+    ev = parse_line(raw)
+    assert slots_for_payload(ev.payload_hash, 16, 2) == \
+        message_slots(ev.payload_hash, 16, 2)

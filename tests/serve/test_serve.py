@@ -1,0 +1,222 @@
+"""The serving frontend end to end: live loopback-socket runs with real
+client threads, the recorded-trace replay contract (bit-identical state
+digest + integer-stat trajectory), frontend window semantics, and the
+CLI's parse-time rejections — the golden tests of docs/serving_frontend.md."""
+
+import asyncio
+import functools
+import json
+import socket
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip.compat import wire
+from tpu_gossip.core.device_topology import device_powerlaw_graph
+from tpu_gossip.core.state import SwarmConfig, init_swarm
+from tpu_gossip.fleet.engine import state_digest, stats_digest
+from tpu_gossip.serve import (
+    ServeDriver,
+    ServeFrontend,
+    ServeTrace,
+    build_step,
+    replay_trace,
+    run_load,
+)
+from tpu_gossip.serve.driver import stack_round_stats
+from tpu_gossip.traffic.ingest import IngestPlan
+
+N, M = 48, 8
+
+
+def asyncio_test(fn):
+    """pytest-asyncio is not in the image; run coroutine tests directly."""
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        return asyncio.run(fn(*a, **kw))
+
+    return wrapper
+
+
+def _swarm():
+    dg = device_powerlaw_graph(N, gamma=2.5, key=jax.random.key(0))
+    graph = dg.as_padded_graph()
+    cfg = SwarmConfig(n_peers=graph.n, msg_slots=M, fanout=3, mode="push")
+
+    def make_state():
+        return init_swarm(graph, cfg, key=jax.random.key(0),
+                          origins=np.array([0]), exists=dg.exists)
+
+    rows = np.flatnonzero(np.asarray(dg.exists))
+    return cfg, make_state, rows
+
+
+def test_live_loopback_replay_bit_identical():
+    """The golden contract: a live socket run — real client threads,
+    jittered arrivals racing the round windows — replays through the
+    pure-sim injection path bit for bit (state + integer stats)."""
+    cfg, make_state, rows = _swarm()
+    plan = IngestPlan(msg_slots=M, max_inject=4, k_hashes=1)
+    fe = ServeFrontend(origin_rows=rows, max_inject=4, port=0)
+    fe.start()
+    try:
+        # a synchronous burst first (guaranteed load: 6 arrivals pending
+        # before round 0, > max_inject so the live run defers + bills)...
+        pre = run_load("127.0.0.1", fe.port, clients=2, msgs_per_client=3,
+                       jitter_s=0.0, seed=3)
+        assert pre.sent == 6 and pre.errors == 0
+        # ...then a jittered load racing the windows for real
+        raced = {}
+        t = threading.Thread(target=lambda: raced.update(
+            rep=run_load("127.0.0.1", fe.port, clients=2, msgs_per_client=4,
+                         jitter_s=0.003, seed=4)))
+        t.start()
+        driver = ServeDriver(build_step(cfg), make_state(), fe, plan,
+                             rounds=10, rounds_per_sec=40.0)
+        rep = driver.run()
+        t.join(timeout=60.0)
+    finally:
+        fe.stop()
+    assert raced["rep"].errors == 0
+    assert rep.trace.num_rounds == 10
+    assert rep.trace.total_arrivals >= 6  # the burst is guaranteed in
+    # the burst overran the first window: deferred arrivals were billed
+    assert int(rep.stats.ingest_overflow.sum()) >= 1
+    # every recorded arrival was injected (deferred != dropped)
+    assert int(rep.stats.ingest_offered.sum()) == rep.trace.total_arrivals
+
+    # replay: a step built the same way + the same initial state
+    fin2, trail = replay_trace(rep.trace, build_step(cfg), make_state())
+    stats2 = stack_round_stats([jax.device_get(s) for s in trail])
+    assert state_digest(fin2) == state_digest(rep.state)
+    assert stats_digest(stats2) == stats_digest(rep.stats)
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    cfg, make_state, rows = _swarm()
+    plan = IngestPlan(msg_slots=M, max_inject=4, k_hashes=1)
+    from tpu_gossip.serve import TraceRecorder
+
+    rec = TraceRecorder(plan)
+    rec.record_round(0, [(2, 12345), (3, 67890)], overflow=0)
+    rec.record_round(1, [], overflow=2)
+    trace = rec.finish()
+    path = tmp_path / "trace.jsonl"
+    trace.save(path)
+    assert ServeTrace.load(path) == trace
+
+
+def test_frontend_window_defers_fifo_and_bills_overflow():
+    fe = ServeFrontend(origin_rows=[0, 1, 2], max_inject=2, port=0)
+    arrivals = [(i, 100 + i) for i in range(5)]
+    with fe._lock:
+        fe._pending.extend(arrivals)
+    w1, ov1 = fe.take_window()
+    assert w1 == arrivals[:2] and ov1 == 3
+    w2, ov2 = fe.take_window()
+    assert w2 == arrivals[2:4] and ov2 == 1  # FIFO carry, re-billed
+    w3, ov3 = fe.take_window()
+    assert w3 == arrivals[4:] and ov3 == 0
+    assert fe.backlog() == 0
+    assert fe.counters.overflow_billed == 4
+
+
+@asyncio_test
+async def test_frontend_speaks_the_reference_wire_protocol():
+    fe = ServeFrontend(origin_rows=list(range(8)), max_inject=4, port=0,
+                       query_snapshot=lambda: {"round": 3, "coverage": 0.5})
+    fe.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", fe.port)
+        # registration: the seed's contract replies with a pickled subset
+        writer.write(wire.encode_peer_handshake(("10.0.0.9", 6000)))
+        await writer.drain()
+        subset_line = await asyncio.wait_for(reader.readline(), timeout=10.0)
+        assert wire.decode_subset(subset_line) == []
+        # PING -> heartbeat (the reference's liveness probe reply)
+        writer.write(wire.encode_ping())
+        await writer.drain()
+        hb = await asyncio.wait_for(reader.readline(), timeout=10.0)
+        assert wire.classify(hb)[0] == "heartbeat"
+        # QUERY -> one JSON line from the driver snapshot
+        writer.write(b"QUERY status\n")
+        await writer.drain()
+        q = await asyncio.wait_for(reader.readline(), timeout=10.0)
+        assert json.loads(q) == {"round": 3, "coverage": 0.5}
+        # gossip + malformed lines are accepted without a reply
+        writer.write(wire.encode_gossip("t0", "10.0.0.9", 6000, 1))
+        writer.write(b"Heartbeat from not-an-addr\n")
+        writer.close()
+    finally:
+        fe.stop()
+    window, overflow = fe.take_window()
+    assert len(window) == 1 and overflow == 0
+    assert fe.counters.registrations == 1
+    assert fe.counters.pings == 1
+    assert fe.counters.malformed == 1
+
+
+def test_frontend_port_conflict_raises():
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    port = blocker.getsockname()[1]
+    try:
+        fe = ServeFrontend(origin_rows=[0], max_inject=1, port=port)
+        with pytest.raises(OSError):
+            fe.start()
+    finally:
+        blocker.close()
+
+
+# --- CLI parse-time rejections (exit 2, before any engine builds) ----------
+
+def _run(argv):
+    from tpu_gossip.cli.run_sim import main
+
+    return main(argv)
+
+
+SERVE = ["serve", "--peers", "48", "--slots", "4", "--fanout", "2",
+         "--quiet"]
+
+
+def test_cli_serve_rejections(capsys):
+    # run-to-coverage has no serving window
+    assert _run(SERVE + ["--slot-ttl", "12"]) == 2
+    assert "fixed horizon" in capsys.readouterr().err
+    # no streaming slot-plane config at all
+    assert _run(SERVE + ["--rounds", "20"]) == 2
+    assert "--slot-ttl" in capsys.readouterr().err
+    # TTL below the feasible coverage horizon
+    assert _run(SERVE + ["--rounds", "20", "--slot-ttl", "2"]) == 2
+    assert "feasible" in capsys.readouterr().err
+    # port outside the valid range
+    assert _run(SERVE + ["--rounds", "20", "--slot-ttl", "12",
+                         "--port", "70000"]) == 2
+    # the sharded serving engine is the matching mesh
+    assert _run(SERVE + ["--rounds", "20", "--slot-ttl", "12",
+                         "--shard"]) == 2
+    assert "matching" in capsys.readouterr().err
+    # compositions the driver does not support yet are named errors
+    assert _run(SERVE + ["--rounds", "20", "--slot-ttl", "12",
+                         "--control", "0.9"]) == 2
+    assert _run(SERVE + ["--rounds", "20", "--slot-ttl", "12",
+                         "--grow", "96"]) == 2
+    assert _run(SERVE + ["--rounds", "20", "--slot-ttl", "12",
+                         "--remat-every", "8"]) == 2
+
+
+def test_cli_serve_port_conflict_exits_2(capsys):
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    port = blocker.getsockname()[1]
+    try:
+        rc = _run(SERVE + ["--rounds", "6", "--slot-ttl", "10",
+                           "--port", str(port)])
+    finally:
+        blocker.close()
+    assert rc == 2
+    assert "cannot listen" in capsys.readouterr().err

@@ -1,0 +1,35 @@
+"""Shared session-scoped builds for tests/sim (tier-1 wall headroom).
+
+The heaviest engine builds used by more than one module live here ONCE
+per pytest session instead of once per module: the virtual 8-device
+mesh and the n=1500 sharded matching (graph, plan) pair that both the
+dist parity suite and the sparse-transport suite run their witnesses
+on. The topology builders memoize on identical args, but routing every
+consumer through one fixture makes the sharing load-bearing — an arg
+drift in one module can no longer silently fork a second multi-second
+build.
+"""
+
+import jax
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpu_gossip.dist import make_mesh
+
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def matching_1500():
+    """The shared sharded-matching build: (graph, plan) at n=1500 on 8
+    shards — the single-chip-vs-mesh witnesses in test_dist.py and the
+    sparse-transport parity witnesses both run on this layout."""
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    return matching_powerlaw_graph_sharded(
+        1500, 8, fanout=2, key=jax.random.key(0)
+    )

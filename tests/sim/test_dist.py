@@ -111,6 +111,9 @@ def test_churn_never_resurrects_pad_slots():
     assert float(fin.coverage(0)) >= 0.99
 
 
+@pytest.mark.slow  # the local engine's twin (tests/sim/test_engine.py::
+# test_rewired_peers_attach_degree_preferentially) keeps the attachment
+# law in tier-1; this sharded rerun rides the slow lane
 def test_rewired_peers_attach_degree_preferentially_dist(setup):
     """BASELINE config 5 in the sharded engine (VERDICT r2 item 4): rejoiners
     draw fresh degree-preferential neighbors AND those fresh edges actually
@@ -231,16 +234,18 @@ def test_dist_local_curve_parity(setup, mode, fanout):
     "mode,extra",
     [
         ("flood", {}),
-        ("push", {}),
-        ("push_pull", {}),
+        pytest.param("push", {}, marks=pytest.mark.slow),
+        pytest.param("push_pull", {}, marks=pytest.mark.slow),
         ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
                            rewire_slots=2)),
         pytest.param("push_pull",
                      dict(churn_leave_prob=0.01, churn_join_prob=0.1,
                           rewire_slots=2, rewire_compact_cap=64),
                      marks=pytest.mark.slow),
-    ],  # churn keeps the re-wiring receive path in tier-1; the compact
-    # twin asserts the same law and rides the slow lane
+    ],  # churn keeps the re-wiring receive path in tier-1 and flood the
+    # everyone-transmits activation; push/push_pull assert the same
+    # scatter-vs-kernel law in between and ride the slow lane with the
+    # compact twin
     ids=["flood", "push", "push_pull", "push_pull_churn",
          "push_pull_churn_compact"],
 )
@@ -293,6 +298,8 @@ def test_kernel_receive_path_multiword(setup):
     np.testing.assert_array_equal(seen_a, np.asarray(fin_b.seen))
 
 
+@pytest.mark.slow  # the ckpt matrix (tests/sim/test_ckpt.py) and the CLI
+# --shard --checkpoint run keep sharded-snapshot resume in tier-1
 def test_dist_checkpoint_resume_local(tmp_path):
     """A sharded run's checkpoint resumes bit-exactly — in the local engine
     (operator takes a multi-chip snapshot to a single chip: the state pytree
@@ -327,17 +334,11 @@ def test_dist_checkpoint_resume_local(tmp_path):
 
 
 @pytest.fixture(scope="module")
-def matching_setup():
-    from tpu_gossip.core.matching_topology import (
-        matching_powerlaw_graph_sharded,
-    )
+def matching_setup(matching_1500, mesh8):
     from tpu_gossip.dist import shard_matching_plan
 
-    g, plan = matching_powerlaw_graph_sharded(
-        1500, 8, fanout=2, key=jax.random.key(0)
-    )
-    mesh = make_mesh(8)
-    return g, plan, shard_matching_plan(plan, mesh), mesh
+    g, plan = matching_1500
+    return g, plan, shard_matching_plan(plan, mesh8), mesh8
 
 
 def _matching_state(g, cfg, seed=3, origins=(0, 5)):
@@ -352,8 +353,8 @@ def _matching_state(g, cfg, seed=3, origins=(0, 5)):
 @pytest.mark.parametrize(
     "mode,extra",
     [
-        ("flood", {}),
-        ("push", {}),
+        pytest.param("flood", {}, marks=pytest.mark.slow),
+        pytest.param("push", {}, marks=pytest.mark.slow),
         ("push_pull", {}),
         pytest.param("push_pull",
                      dict(churn_leave_prob=0.02, churn_join_prob=0.2,
@@ -367,8 +368,9 @@ def _matching_state(g, cfg, seed=3, origins=(0, 5)):
         # forward_once is the only config taking the answer-bitmap branch
         # (a second expand+pipeline pass per word group inside shard_map)
         ("push_pull", dict(forward_once=True)),
-    ],  # the churn twins are the dear rows; the scenario-parity flood case
-    # and the sparse push_pull case keep churny dist rounds in tier-1
+    ],  # push_pull (both lanes) + fwd_once (the answer-bitmap branch) are
+    # the tier-1 witnesses; flood/push assert the same single-chip parity
+    # law through cheaper heads and ride the slow lane with the churn twins
     ids=["flood", "push", "push_pull", "push_pull_churn",
          "push_pull_churn_compact", "push_pull_sir", "push_pull_fwd_once"],
 )
@@ -410,6 +412,8 @@ def test_matching_dist_reaches_coverage(matching_setup):
     assert int(fin.round) < 60
 
 
+@pytest.mark.slow  # multiword receive stays tier-1 via the bucketed
+# test_kernel_receive_path_multiword; this matching twin rides slow
 def test_matching_dist_multiword(matching_setup):
     """m > 32: one pipeline application per 32-slot word group per shard,
     same edge activation across groups — still bit-exact vs local."""
@@ -485,11 +489,15 @@ def test_matching_dist_rejects_mismatched_mesh(matching_setup):
     "mode,extra,kernel",
     [
         ("push", {}, False),
-        ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
-                           rewire_slots=2), False),
+        pytest.param("push_pull", dict(churn_leave_prob=0.01,
+                                       churn_join_prob=0.1,
+                                       rewire_slots=2), False,
+                     marks=pytest.mark.slow),
         ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
                            rewire_slots=2), True),
-    ],
+    ],  # the kernel-receive churn row subsumes the scatter-receive one
+    # (same global-view re-wiring path outside shard_map); the scatter
+    # twin rides the slow lane
     ids=["push", "push_pull_churn", "push_pull_churn_kernel"],
 )
 def test_sharding_layout(setup, mode, extra, kernel):
@@ -584,6 +592,8 @@ def test_matching_dist_scenario_bit_identical(matching_setup, mode, extra):
     assert np.asarray(stats_l.msgs_held).max() > 0
 
 
+@pytest.mark.slow  # the matching-engine scenario flood witness keeps
+# scenario parity in tier-1; this bucketed twin rides the slow lane
 def test_bucketed_scenario_flood_parity_with_single_device(setup):
     """Flood is deterministic, so the bucketed mesh under a scenario must
     match the single-device engine bit for bit — loss/delay draws land at
@@ -766,6 +776,9 @@ def test_bucketed_scenario_kernel_receive_parity(setup):
         )
 
 
+@pytest.mark.slow  # tests/sim/test_faults.py::
+# test_split_brain_stalls_at_boundary_then_heals keeps the stall-and-heal
+# law in tier-1; this mesh rerun rides the slow lane
 def test_split_brain_heals_on_the_mesh(matching_setup):
     """The acceptance scenario end-to-end on the mesh: coverage stalls at
     the partition boundary, then recovers past 99% after heal."""

@@ -54,6 +54,9 @@ def builds(mesh):
     return g1, p1, g2, p2
 
 
+@pytest.mark.slow  # CI's builder-smoke job runs this file INCLUDING the
+# slow rows on every push (see module docstring) — the n=256 build pair
+# is the long pole, so the conformance checks ride there, out of tier-1
 def test_dist_build_bit_identical_to_block_keys_local(builds):
     g1, p1, g2, p2 = builds
     assert p1.classes == p2.classes
@@ -178,6 +181,8 @@ def test_dist_build_csr_free_row_ptr_identical(mesh):
     assert g2.col_idx.shape == (1,)  # the CSR-free sentinel shape
 
 
+@pytest.mark.slow  # rides with the build pair in CI's builder-smoke job;
+# the host-side plan_table_widths declarations stay covered there too
 def test_degree_tables_declared_narrow(builds):
     """The registry-declared int16 degree tables land when d_max fits the
     cap (every tracked scale) and stay int32 when it cannot."""

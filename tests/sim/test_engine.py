@@ -317,6 +317,8 @@ def test_resume_equivalence_full_state_machine(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=f.name)
 
 
+@pytest.mark.slow  # same coverage note as the full-machine compose above;
+# the staircase plan itself is pinned by the kernel parity suite
 def test_resume_equivalence_pallas_path(tmp_path):
     """Same losslessness through the sampled staircase kernel."""
     import jax

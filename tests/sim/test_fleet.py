@@ -86,7 +86,10 @@ def _assert_lane_bit_identical(camp, fin, stats, k):
         )
 
 
-@pytest.mark.parametrize("k", [0, 7, 13])
+@pytest.mark.parametrize(
+    "k", [0, pytest.param(7, marks=pytest.mark.slow), 13]
+)  # one lane per family in tier-1 (0: loss sweep, 13: bound×rate sweep);
+# the second loss-family sample rides the slow lane
 def test_lane_bit_identical_to_solo(composed, k):
     """3 sampled lanes of the 16-lane composed campaign — incl. lanes of
     both families (loss sweep / bound×rate sweep) — reproduce their solo
@@ -96,6 +99,9 @@ def test_lane_bit_identical_to_solo(composed, k):
     _assert_lane_bit_identical(camp, fin, stats, k)
 
 
+@pytest.mark.slow  # the fleet-smoke CI job exercises the digest pair
+# across real processes on every push; the in-process equality check
+# rides the slow lane
 def test_lane_digests_match_solo(composed):
     """The digest pair the fleet-smoke CI job compares across processes
     equals the in-process comparison."""

@@ -58,6 +58,8 @@ def test_jsonl_rows(setup):
         "control_refreshed",
         "evictions_new", "false_evictions", "n_quarantined",
         "dead_undeclared", "adv_accusations", "adv_forged",
+        "ingest_offered", "ingest_injected", "ingest_conflated",
+        "ingest_overflow",
     }
     # the streaming plane's per-slot tracks emit as JSON lists (one entry
     # per dedup slot); scalars stay scalars — and an unloaded run's
@@ -83,6 +85,9 @@ def test_cli_run_to_target(capsys):
     assert summary["coverage"] >= summary["target"]
 
 
+@pytest.mark.slow  # test_cli_shard_fixed_horizon_with_churn below keeps
+# the --shard CLI path (churn + checkpoint included) in tier-1; the
+# run-to-target + --staircase variant rides the slow lane
 def test_cli_shard_run_to_target(capsys):
     """--shard runs the dist engine over the (virtual 8-device) mesh; with
     --staircase the receive side is the per-shard kernel (north-star CLI)."""

@@ -146,15 +146,16 @@ def _matching_planes(plan, composed: bool):
 @pytest.mark.parametrize(
     "mode,extra,composed",
     [
-        ("flood", {}, False),
-        ("push", {}, False),
+        pytest.param("flood", {}, False, marks=pytest.mark.slow),
+        pytest.param("push", {}, False, marks=pytest.mark.slow),
         ("push_pull", {}, False),
         pytest.param("push_pull", dict(rewire_slots=ATTACH, **{
             k: v for k, v in _CHURN.items() if k != "rewire_slots"
         }), True, marks=pytest.mark.slow),
     ],
     ids=["flood", "push", "push_pull", "composed"],
-)  # the composed cell is the long pole; plain modes carry tier-1
+)  # push_pull (both lanes) is the tier-1 depth-0 witness; flood/push
+# assert the same law and ride the slow lane with the composed long pole
 def test_matching_depth0_bit_identical_to_serial(
     matching_setup, mode, extra, composed
 ):

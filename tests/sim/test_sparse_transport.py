@@ -46,15 +46,12 @@ def setup():
 
 
 @pytest.fixture(scope="module")
-def matching_setup():
-    from tpu_gossip.core.matching_topology import (
-        matching_powerlaw_graph_sharded,
-    )
-
-    g, plan = matching_powerlaw_graph_sharded(
-        6000, 8, fanout=2, key=jax.random.key(0)
-    )
-    mesh = make_mesh(8)
+def matching_setup(matching_1500, mesh8):
+    """The session-shared n=1500 sharded-matching build (tests/sim/
+    conftest.py) — the same layout the dist parity suite runs on, so the
+    multi-second build happens once per session, not once per module."""
+    g, plan = matching_1500
+    mesh = mesh8
     plan_m = shard_matching_plan(plan, mesh)
     return g, plan, plan_m, mesh, build_transport(plan_m, mode="sparse", mesh=mesh)
 
@@ -211,14 +208,16 @@ def test_transport_rejects_mismatched_layout(setup, matching_setup):
 @pytest.mark.parametrize(
     "mode,extra",
     [
-        ("flood", {}),
-        ("push", {}),
-        ("push_pull", {}),
+        pytest.param("flood", {}, marks=pytest.mark.slow),
+        pytest.param("push", {}, marks=pytest.mark.slow),
+        pytest.param("push_pull", {}, marks=pytest.mark.slow),
         pytest.param("push_pull", dict(forward_once=True),
                      marks=pytest.mark.slow),
         ("push_pull", dict(churn_leave_prob=0.01, churn_join_prob=0.1,
                            rewire_slots=2)),
-    ],  # churn is the richer witness; the fwd_once twin rides slow
+    ],  # churn (both lanes + re-wiring live) is the tier-1 witness; the
+    # plainer modes assert the same compaction-invisibility law and ride
+    # the slow lane with the fwd_once twin
     ids=["flood", "push", "push_pull", "push_pull_fwd_once",
          "push_pull_churn"],
 )
@@ -357,7 +356,7 @@ def test_matching_sparse_scenario_bit_identical(matching_setup):
         return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
 
     sc = compile_scenario(
-        _chaos_spec(), n_peers=6000, n_slots=plan.n, total_rounds=8,
+        _chaos_spec(), n_peers=1500, n_slots=plan.n, total_rounds=8,
         node_map=rows_of,
     )
     fin_l, stats_l = simulate(clone_state(st), cfg, 6, plan, "fused", sc)
@@ -404,11 +403,23 @@ def test_matching_sparse_growing_bit_identical():
 # --------------------------------------------------------- ici accounting
 @pytest.mark.slow  # multi-round billing curve; the parity witness asserts
 # sparse_lanes > 0 so the tier-1 lane-activity guard remains
-def test_ici_counter_early_phase_reduction(matching_setup):
+def test_ici_counter_early_phase_reduction():
     """The analytic counter: early-phase shipped bytes must undercut dense
     by >= 3x (the ROADMAP success metric, tracked from this PR on), and
-    the trajectory must go dense mid-epidemic."""
-    g, plan, plan_m, mesh, tr = matching_setup
+    the trajectory must go dense mid-epidemic. Needs a swarm big enough
+    for the per-lane header + hub sub-lane overhead to amortize (at the
+    tier-1 fixture's n=1500 the fixed overhead eats the early-phase win),
+    so this slow-lane test keeps its own n=6000 build."""
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    g, plan = matching_powerlaw_graph_sharded(
+        6000, 8, fanout=2, key=jax.random.key(0)
+    )
+    mesh = make_mesh(8)
+    plan_m = shard_matching_plan(plan, mesh)
+    tr = build_transport(plan_m, mode="sparse", mesh=mesh)
     cfg = SwarmConfig(n_peers=plan.n, msg_slots=8, fanout=2, mode="push_pull")
     st = init_swarm(g.as_padded_graph(), cfg, origins=[0],
                     exists=g.exists, key=jax.random.key(3))

@@ -131,6 +131,12 @@ def _stats_contract(stats, problems: list, leading=(), msg_slots=None) -> None:
         "dead_undeclared": (jnp.int32, ()),
         "adv_accusations": (jnp.int32, ()),
         "adv_forged": (jnp.int32, ()),
+        # live-ingestion track (serve/ + traffic/ingest.py): the serving
+        # frontend's batched-arrival counters — all scalar int32
+        "ingest_offered": (jnp.int32, ()),
+        "ingest_injected": (jnp.int32, ()),
+        "ingest_conflated": (jnp.int32, ()),
+        "ingest_overflow": (jnp.int32, ()),
     }
     for field, (dt, trailing) in declared.items():
         leaf = getattr(stats, field, None)

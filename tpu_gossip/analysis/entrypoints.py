@@ -220,6 +220,21 @@ def _stream_plan(msg_slots: int, exists, *, k_hashes: int = 2):
     )
 
 
+def _ingest_batch(msg_slots: int, *, max_inject: int = 4):
+    """One live round window as the serving driver builds it (serve/ →
+    traffic/ingest.py): a static-shape InjectBatch with real FNV-hashed
+    payload identities, a short window, and a non-zero overflow bill —
+    the post-tail landing scatter the recorded-trace replay contract
+    re-runs bit for bit."""
+    from tpu_gossip.serve import payload_hash64
+    from tpu_gossip.traffic.ingest import IngestPlan, make_batch
+
+    plan = IngestPlan(msg_slots=msg_slots, max_inject=max_inject, k_hashes=1)
+    hashes = [payload_hash64(f"2025-01-01 00:00:0{i}:10.0.0.{i}:6000:{i}")
+              for i in range(3)]
+    return make_batch(plan, [1, 2, 3], hashes, overflow=2)
+
+
 def _control_plan(ttl: int = 0):
     """A small compiled control policy (control/) so the CONTROLLED round
     traces its full structure — the level resolve, the width-``hi``
@@ -423,6 +438,27 @@ def _local_entries() -> list[EntryPoint]:
         eps.append(EntryPoint(
             name=f"local[{eng},stream]", engine=eng, kind="round",
             audit_check="gossip_round_local", build=build_stream,
+            n_peers=graph.n_pad,
+        ))
+
+    # the SERVED round (serve/ → traffic/ingest): a live round window's
+    # static-shape InjectBatch lands post-tail on every local delivery
+    # engine, beside an active lease table — the injection path the
+    # recorded-trace replay contract holds bit-identical to the live run
+    for eng, graph, plan in engines:
+        def build_ingest(graph=graph, plan=plan):
+            st, cfg = ctx["state_for"](graph, 16, mode="push_pull")
+            sp = _stream_plan(16, graph.exists)
+            batch = _ingest_batch(16)
+            return (
+                lambda s: engine.gossip_round(s, cfg, plan, stream=sp,
+                                              inject=batch),
+                st,
+            )
+
+        eps.append(EntryPoint(
+            name=f"local[{eng},ingest]", engine=eng, kind="round",
+            audit_check="gossip_round_local", build=build_ingest,
             n_peers=graph.n_pad,
         ))
 
@@ -741,6 +777,9 @@ def _dist_entries() -> list[EntryPoint]:
                 kw["transport"] = tp.build_transport(graph_plan, mode="sparse")
             if kw.pop("stream", False):
                 kw["stream"] = _stream_plan(16, st.exists)
+            if kw.pop("ingest", False):
+                kw["stream"] = _stream_plan(16, st.exists)
+                kw["inject"] = _ingest_batch(16)
             if kw.pop("control", False):
                 kw["control"] = _control_plan()
             if kw.pop("pipeline", False):
@@ -792,6 +831,13 @@ def _dist_entries() -> list[EntryPoint]:
     eps.append(dist_ep(
         "dist[matching,stream]", "dist-matching", "gossip_round_dist",
         {}, dict(stream=True),
+    ))
+    # the SERVED mesh round (serve/): a live window's InjectBatch lands
+    # at global shape post-tail — the sharded serving engine's half of
+    # the recorded-trace replay contract
+    eps.append(dist_ep(
+        "dist[matching,ingest]", "dist-matching", "gossip_round_dist",
+        {}, dict(ingest=True),
     ))
     # the ADVERSARIAL mesh round: the Byzantine scatters and the quorum
     # machine run at global shape outside shard_map — the adversarial

@@ -359,6 +359,11 @@ def main(argv: list[str] | None = None) -> int:
         # from the manifest's recorded config, and continue to the
         # original horizon bit for bit
         return _main_resume(argv[1:])
+    if argv and argv[0] == "serve":
+        # the live ingestion frontend (tpu_gossip/serve/,
+        # docs/serving_frontend.md): accept reference-wire clients on a
+        # socket and disseminate their payloads through the device swarm
+        return _main_serve(argv[1:])
     args = build_parser().parse_args(argv)
     return _run(args)
 
@@ -2646,6 +2651,362 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     if args.checkpoint:
         save_swarm(args.checkpoint, fin)
     return 0
+
+
+def _add_serve_args(p) -> None:
+    g = p.add_argument_group(
+        "serving", "run_sim serve: the live ingestion frontend "
+        "(tpu_gossip/serve/, docs/serving_frontend.md)"
+    )
+    g.add_argument("--port", type=int, default=0, metavar="P",
+                   help="listen port (0 = ephemeral; the bound port is "
+                        "announced on stderr)")
+    g.add_argument("--serve-host", type=str, default="127.0.0.1",
+                   metavar="H", help="listen address")
+    g.add_argument("--rounds-per-sec", type=float, default=0.0, metavar="R",
+                   help="pace round windows at R/sec (0 = unpaced: as "
+                        "fast as the device steps)")
+    g.add_argument("--max-inject", type=int, default=64, metavar="J",
+                   help="static per-round injection batch; arrivals past "
+                        "it defer to the next window and are counted as "
+                        "overflow — never dropped silently")
+    g.add_argument("--trace-out", type=str, default="", metavar="F",
+                   help="record every accepted arrival as (round, origin, "
+                        "payload_hash) to this JSONL — the bit-exact "
+                        "replay input (serve/trace.py)")
+    g.add_argument("--replay-check", action="store_true",
+                   help="after serving, replay the recorded trace through "
+                        "the pure-sim injection path and fail (exit 1) "
+                        "unless state digest + integer-stat trajectory "
+                        "match bit for bit")
+    g.add_argument("--serve-target-ratio", type=float, default=0.9,
+                   metavar="T", help="delivery-ratio target the "
+                        "reliability report certifies against")
+
+
+def _validate_serve(args):
+    """Reject impossible serving configs; returns an error string (exit
+    2) or None — the serving twin of :func:`_validate_stream`."""
+    if args.rounds <= 0:
+        return ("serve runs a fixed horizon of round windows — pass "
+                "--rounds R; run-to-coverage has no serving window to "
+                "batch arrivals into")
+    if not (0 <= args.port <= 65535):
+        return f"--port {args.port} outside [0, 65535]"
+    if args.rounds_per_sec < 0:
+        return f"--rounds-per-sec {args.rounds_per_sec} must be >= 0"
+    if args.max_inject < 1:
+        return f"--max-inject {args.max_inject} must be >= 1"
+    if args.stream <= 0 and args.slot_ttl == 0:
+        return ("serve lands live arrivals in the streaming slot plane, "
+                "which needs its age-out lease configured: pass "
+                "--slot-ttl T (and optionally --stream RATE for "
+                "background synthetic load)")
+    if args.stream <= 0:
+        # rate-0 stream: validate the slot-plane knobs ourselves (the
+        # standard validator treats a TTL without a rate as a config
+        # error, but serving IS the rate here)
+        from tpu_gossip.traffic import min_feasible_ttl
+
+        if not (1 <= args.stream_hashes <= args.slots):
+            return (f"--stream-hashes {args.stream_hashes} outside "
+                    f"[1, --slots {args.slots}] — the Bloom planes live "
+                    "in the slot dimension")
+        feasible = min_feasible_ttl(args.peers, args.fanout, args.mode)
+        if args.slot_ttl < feasible:
+            return (f"--slot-ttl {args.slot_ttl} is below the feasible "
+                    f"coverage horizon (~{feasible} rounds for "
+                    f"{args.peers} peers at fanout {args.fanout}) — every "
+                    "served message would be recycled before it could "
+                    "possibly cover")
+    else:
+        err = _validate_stream(args)
+        if err:
+            return err
+    if args.scenario:
+        return ("serve does not compose with --scenario yet: fault "
+                "phases would make live delivery attribution ambiguous "
+                "(run the fault catalogue through run_sim/fleet instead)")
+    if args.grow:
+        return ("serve does not compose with --grow yet: grown peers "
+                "have no client-addressable identity to map arrivals "
+                "onto")
+    if args.control > 0:
+        return ("serve does not compose with --control yet: the "
+                "controller and the live load would chase each other's "
+                "delivery ratio — serve certifies the STATIC protocol")
+    if args.remat_every > 0:
+        return ("serve cannot compose with --remat-every: the epoch "
+                "re-partition permutes peers, so the frontend's "
+                "client-to-row map would inject at the wrong rows")
+    if args.pipeline is not None:
+        return ("serve double-buffers the injection window against the "
+                "in-flight device round itself (serve/driver.py); "
+                "--pipeline's exchange overlap does not compose with it")
+    if args.profile_round > 0:
+        return "--profile-round decomposes the offline round; drop it for serve"
+    if args.transport != "dense":
+        return (f"--transport {args.transport} is not wired through the "
+                "serving driver; run the transport A/B offline")
+    if getattr(args, "checkpoint_every", 0):
+        return ("serve does not checkpoint mid-run (the trace IS the "
+                "recovery artifact: replay it); drop --checkpoint-every")
+    if args.shard and args.graph != "matching":
+        return ("serve's sharded engine is the matching mesh "
+                "(dist/matching_mesh.py); add --graph matching or drop "
+                "--shard")
+    return None
+
+
+def _main_serve(argv: list[str]) -> int:
+    """``run_sim serve``: accept reference-wire clients on a socket and
+    disseminate their payloads through the device swarm (tentpole of
+    docs/serving_frontend.md).
+
+    The frontend thread batches arrivals per round window; the driver
+    double-buffers each window's injection against the in-flight device
+    round and records the ``(round, origin, payload_hash)`` trace whose
+    replay is bit-identical to the live run (``--replay-check`` proves
+    it in-process). The summary row carries the steady-state serving
+    report, the certified reliability block, the frontend counters and
+    the state/stats digests.
+    """
+    import jax
+
+    from tpu_gossip.core import topology
+    from tpu_gossip.core.state import SwarmConfig, init_swarm, save_swarm
+    from tpu_gossip.sim import metrics as M
+
+    p = build_parser()
+    _add_serve_args(p)
+    args = p.parse_args(argv)
+    err = _validate_serve(args)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+
+    rng = np.random.default_rng(args.seed)
+    origins, silent_ids = _sample_ids(args, rng)
+    mesh = None
+    plan = None
+
+    if args.graph == "matching" and args.shard:
+        from tpu_gossip.core.matching_topology import (
+            matching_powerlaw_graph_sharded,
+        )
+        from tpu_gossip.dist import (
+            make_mesh, shard_matching_plan, shard_swarm,
+        )
+
+        mesh = make_mesh()
+        if 128 % mesh.size:
+            print(f"serve: mesh size {mesh.size} does not divide 128 "
+                  "(the sharded matching transpose's lane split)",
+                  file=sys.stderr)
+            return 2
+        dgraph, plan = matching_powerlaw_graph_sharded(
+            args.peers, mesh.size, gamma=args.gamma,
+            fanout=None if args.mode == "flood" else args.fanout,
+            key=jax.random.key(args.seed),
+        )
+        plan = shard_matching_plan(plan, mesh)
+
+        def to_rows(ids):
+            ids = np.asarray(ids)
+            return (ids // plan.n_per) * plan.n_blk + (ids % plan.n_per)
+
+        cfg = SwarmConfig(
+            n_peers=plan.n, msg_slots=args.slots, fanout=args.fanout,
+            mode=args.mode, forward_once=args.forward_once,
+            sir_recover_rounds=args.sir_recover,
+            churn_leave_prob=args.churn_leave,
+            churn_join_prob=args.churn_join,
+            rewire_slots=_rewire_slots(args),
+            rewire_compact_cap=args.rewire_compact_cap,
+        )
+        origin_rows = np.asarray(to_rows(np.arange(args.peers)))
+
+        def make_state():
+            st = init_swarm(
+                dgraph.as_padded_graph(), cfg,
+                key=jax.random.key(args.seed), origins=to_rows(origins),
+                exists=dgraph.exists,
+            )
+            if silent_ids is not None:
+                st.silent = st.silent.at[to_rows(silent_ids)].set(True)
+            return shard_swarm(st, mesh)
+    else:
+        exists = None
+        if args.graph == "matching":
+            from tpu_gossip.core.matching_topology import (
+                matching_powerlaw_graph,
+            )
+
+            dgraph, plan = matching_powerlaw_graph(
+                args.peers, gamma=args.gamma,
+                fanout=None if args.mode == "flood" else args.fanout,
+                key=jax.random.key(args.seed),
+            )
+            graph, exists = dgraph.as_padded_graph(), dgraph.exists
+        elif args.graph == "pa":
+            edges = topology.preferential_attachment(args.peers, m=args.m,
+                                                     rng=rng)
+            graph = topology.build_csr(args.peers, edges)
+        else:
+            deg = topology.powerlaw_degree_sequence(args.peers,
+                                                    gamma=args.gamma,
+                                                    rng=rng)
+            edges = topology.configuration_model(deg, rng=rng)
+            graph = topology.build_csr(args.peers, edges)
+        cfg = SwarmConfig(
+            n_peers=graph.n, msg_slots=args.slots, fanout=args.fanout,
+            mode=args.mode, forward_once=args.forward_once,
+            sir_recover_rounds=args.sir_recover,
+            churn_leave_prob=args.churn_leave,
+            churn_join_prob=args.churn_join,
+            rewire_slots=_rewire_slots(args),
+            rewire_compact_cap=args.rewire_compact_cap,
+        )
+        origin_rows = (np.flatnonzero(np.asarray(exists))
+                       if exists is not None else np.arange(graph.n))
+        _mk_exists = exists
+
+        def make_state():
+            st = init_swarm(graph, cfg, key=jax.random.key(args.seed),
+                            origins=origins, exists=_mk_exists)
+            if silent_ids is not None:
+                st.silent = st.silent.at[silent_ids].set(True)
+            return st
+
+    if args.stream > 0:
+        strm = _compile_cli_stream(args, origin_rows)
+    else:
+        # rate-0 stream: a masked no-op injection whose age-out lease and
+        # per-slot tracks are exactly what the LIVE arrivals ride
+        from tpu_gossip.traffic import compile_stream
+
+        strm = compile_stream(
+            rate=0.0, msg_slots=args.slots, ttl=args.slot_ttl,
+            origin_rows=origin_rows, k_hashes=args.stream_hashes,
+        )
+    lqs = _compile_cli_liveness(args)
+
+    from tpu_gossip.core.packed import pack_state, unpack_state
+    from tpu_gossip.serve import ServeDriver, ServeFrontend, build_step
+    from tpu_gossip.traffic.ingest import IngestPlan
+
+    ingest_plan = IngestPlan(msg_slots=args.slots,
+                             max_inject=args.max_inject,
+                             k_hashes=args.stream_hashes)
+
+    def fresh_state():
+        st = make_state()
+        return pack_state(st) if args.packed else st
+
+    def fresh_step():
+        return build_step(cfg, plan, mesh=mesh,
+                          tail=args.tail if not args.shard else "fused",
+                          stream=strm, liveness=lqs)
+
+    driver_box: dict = {}
+    frontend = ServeFrontend(
+        host=args.serve_host, port=args.port, origin_rows=origin_rows,
+        max_inject=args.max_inject,
+        query_snapshot=lambda: (
+            driver_box["d"].snapshot() if "d" in driver_box else {}
+        ),
+    )
+    try:
+        frontend.start()
+    except (OSError, TimeoutError) as e:
+        print(f"serve: cannot listen on "
+              f"{args.serve_host}:{args.port}: {e}", file=sys.stderr)
+        return 2
+
+    # announce the bound port BEFORE the first round so scripted clients
+    # (loadgen, the CI smoke job) can connect while the run is live
+    print(json.dumps({"serving": True, "host": args.serve_host,
+                      "port": frontend.port, "rounds": args.rounds,
+                      "rounds_per_sec": args.rounds_per_sec,
+                      "max_inject": args.max_inject}),
+          file=sys.stderr, flush=True)
+
+    driver = ServeDriver(
+        fresh_step(), fresh_state(), frontend, ingest_plan,
+        rounds=args.rounds, rounds_per_sec=args.rounds_per_sec,
+        coverage_target=args.target,
+    )
+    driver_box["d"] = driver
+    try:
+        rep = driver.run()
+    finally:
+        frontend.stop()
+
+    from tpu_gossip.fleet.engine import state_digest, stats_digest
+
+    stats = rep.stats
+    live_sd = state_digest(rep.state)
+    live_td = stats_digest(stats)
+    if not args.quiet:
+        M.write_jsonl(stats, sys.stdout)
+
+    round_seconds = (1.0 / args.rounds_per_sec if args.rounds_per_sec > 0
+                     else cfg.round_seconds)
+    warmup = min(args.slot_ttl, args.rounds // 2)
+    summary = _horizon_summary(args, stats)
+    summary["serve"] = {
+        "host": args.serve_host, "port": frontend.port,
+        "rounds_per_sec": args.rounds_per_sec,
+        "max_inject": args.max_inject,
+        "wall_seconds": round(rep.wall_seconds, 3),
+        "ms_per_round": round(1000.0 * rep.wall_seconds / args.rounds, 3),
+        "trace_rounds": rep.trace.num_rounds,
+        "trace_arrivals": rep.trace.total_arrivals,
+        "ingest_offered": int(np.asarray(stats.ingest_offered).sum()),
+        "ingest_injected": int(np.asarray(stats.ingest_injected).sum()),
+        "ingest_conflated": int(np.asarray(stats.ingest_conflated).sum()),
+        "ingest_overflow": int(np.asarray(stats.ingest_overflow).sum()),
+        "counters": frontend.counters.as_dict(),
+    }
+    summary["steady_state"] = M.steady_state_report(
+        stats, target=args.target, round_seconds=round_seconds,
+        warmup_rounds=warmup,
+    )
+    summary["reliability"] = M.reliability_report(
+        stats, target_ratio=args.serve_target_ratio,
+        coverage_target=args.target, round_seconds=round_seconds,
+    )
+    summary["state_digest"] = live_sd
+    summary["stats_digest"] = live_td
+
+    if args.trace_out:
+        rep.trace.save(args.trace_out)
+        summary["serve"]["trace_path"] = args.trace_out
+
+    rc = 0
+    if args.replay_check:
+        from tpu_gossip.serve import replay_trace
+        from tpu_gossip.serve.driver import stack_round_stats
+
+        fin2, trail = replay_trace(rep.trace, fresh_step(), fresh_state())
+        stats2 = stack_round_stats([jax.device_get(s) for s in trail])
+        replay_sd, replay_td = state_digest(fin2), stats_digest(stats2)
+        identical = (replay_sd == live_sd and replay_td == live_td)
+        summary["replay"] = {
+            "state_digest": replay_sd, "stats_digest": replay_td,
+            "bit_identical": identical,
+        }
+        if not identical:
+            print("serve: trace replay DIVERGED from the live run "
+                  f"(state {live_sd[:12]}../{replay_sd[:12]}.., stats "
+                  f"{live_td[:12]}../{replay_td[:12]}..)", file=sys.stderr)
+            rc = 1
+
+    print(json.dumps(summary))
+    if args.checkpoint:
+        fin = unpack_state(rep.state) if args.packed else rep.state
+        save_swarm(args.checkpoint, fin)
+    return rc
 
 
 if __name__ == "__main__":

@@ -548,6 +548,7 @@ def gossip_round_dist_matching(
     control=None,
     pipeline=None,
     liveness=None,
+    inject=None,
 ) -> tuple[SwarmState, "jax.Array"]:
     """One multi-chip matching round: sharded pipeline + shared protocol
     tail.
@@ -605,7 +606,7 @@ def gossip_round_dist_matching(
     if is_packed(state):
         return _gossip_round_dist_matching_packed(
             state, cfg, plan, mesh, scenario, growth, transport,
-            collect_ici, stream, control, pipeline, liveness,
+            collect_ici, stream, control, pipeline, liveness, inject,
         )
 
     def disseminate(tx, tr, rc, k_dpush, k_dpull, rctl):
@@ -617,7 +618,7 @@ def gossip_round_dist_matching(
     out = run_protocol_round(
         state, cfg, disseminate, scenario=scenario, growth=growth,
         stream=stream, control=control, pipeline=pipeline,
-        liveness=liveness,
+        liveness=liveness, inject=inject,
     )
     if not collect_ici:
         return out
@@ -631,7 +632,8 @@ def gossip_round_dist_matching(
 
 def _gossip_round_dist_matching_packed(ps, cfg, plan, mesh, scenario, growth,
                                        transport, collect_ici, stream,
-                                       control, pipeline, liveness):
+                                       control, pipeline, liveness,
+                                       inject=None):
     """Packed-NATIVE matching round: the shared packed driver carries the
     dispatch stages on the words, and — unlike the bucketed engine —
     delivery itself is word-native: the transpose pipeline already moves
@@ -710,7 +712,7 @@ def _gossip_round_dist_matching_packed(ps, cfg, plan, mesh, scenario, growth,
     out = run_protocol_round_packed(
         ps, cfg, deliver_words, deliver_bool_factory, scenario=scenario,
         growth=growth, stream=stream, control=control, pipeline=pipeline,
-        liveness=liveness,
+        liveness=liveness, inject=inject,
     )
     if not collect_ici:
         return out

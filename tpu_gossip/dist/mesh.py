@@ -934,6 +934,7 @@ def gossip_round_dist(
     control=None,
     pipeline=None,
     liveness=None,
+    inject=None,
 ) -> tuple[SwarmState, RoundStats]:
     """One multi-chip round: bucketed exchange + the shared protocol tail.
 
@@ -992,7 +993,7 @@ def gossip_round_dist(
                                           collect_ici=collect_ici,
                                           stream=stream, control=control,
                                           pipeline=pipeline,
-                                          liveness=liveness)
+                                          liveness=liveness, inject=inject)
     if sg.n_shards != mesh.size:
         raise ValueError(
             f"graph partitioned for {sg.n_shards} shards but mesh has "
@@ -1003,7 +1004,7 @@ def gossip_round_dist(
     if is_packed(state):
         return _gossip_round_dist_packed(
             state, cfg, sg, mesh, shard_plan, scenario, growth, transport,
-            collect_ici, stream, control, pipeline, liveness,
+            collect_ici, stream, control, pipeline, liveness, inject,
         )
 
     def disseminate(tx, tr, rc, k_dpush, k_dpull, rctl):
@@ -1015,7 +1016,7 @@ def gossip_round_dist(
     out = run_protocol_round(
         state, cfg, disseminate, scenario=scenario, growth=growth,
         stream=stream, control=control, pipeline=pipeline,
-        liveness=liveness,
+        liveness=liveness, inject=inject,
     )
     if not collect_ici:
         return out
@@ -1030,7 +1031,7 @@ def gossip_round_dist(
 
 def _gossip_round_dist_packed(ps, cfg, sg, mesh, shard_plan, scenario, growth,
                               transport, collect_ici, stream, control,
-                              pipeline, liveness):
+                              pipeline, liveness, inject=None):
     """Packed-NATIVE bucketed round: the shared packed driver
     (sim/packed_engine.run_protocol_round_packed) carries every dispatch
     stage on the words; the bucketed CSR exchange is the one stage that
@@ -1145,6 +1146,7 @@ def simulate_dist(
     control=None,
     pipeline=None,
     liveness=None,
+    inject=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Fixed-horizon multi-chip run (lax.scan), per-round stats history.
 
@@ -1164,19 +1166,24 @@ def simulate_dist(
     scan carry IS the packed pytree (peer-axis sharding preserved), and
     no full-width state round-trip survives between rounds — the packed
     mesh trajectory stays bit-identical to the unpacked one (and,
-    transitively, to the local engine's).
+    transitively, to the local engine's). ``inject`` threads a STACKED
+    :class:`~tpu_gossip.traffic.InjectBatch` (leading ``num_rounds``
+    axis) through the scan as its xs — the whole-run replay path for a
+    recorded live-serving trace (serve/trace.py) on the mesh engines;
+    ``None`` runs uninjected.
     """
 
-    def body(carry, _):
+    def body(carry, batch):
         out = gossip_round_dist(carry, cfg, sg, mesh, shard_plan,
                                 scenario, growth, transport, collect_ici,
-                                stream, control, pipeline, liveness)
+                                stream, control, pipeline, liveness,
+                                inject=batch)
         if collect_ici:
             nxt, stats, ici = out
             return nxt, (stats, ici)
         return out
 
-    return jax.lax.scan(body, state, None, length=num_rounds)
+    return jax.lax.scan(body, state, inject, length=num_rounds)
 
 
 @functools.partial(

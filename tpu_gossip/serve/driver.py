@@ -1,0 +1,188 @@
+"""The serving round driver: double-buffer live windows against device rounds.
+
+The reference's ``gossip_sender`` overlaps nothing: one blocking
+``sendall`` per neighbor per tick (reference Peer.py:395-408, see
+PARITY.md "Overlapped rounds"). This driver overlaps everything that
+can be overlapped, the way ``pipe_buf`` double-buffers the sharded
+exchange: each loop iteration DISPATCHES round r's jitted step (async
+under JAX's dispatch model) and only then blocks fetching round r-1's
+stats to the host — so host work (window batching, trace recording,
+metrics, client queries) rides inside the device's compute shadow, and
+the device never waits on a stats fetch of its own round.
+
+One step per run: :func:`build_step` jits a single closure over the
+engine config (local or sharded matching; packed states dispatch
+inside ``gossip_round`` itself), with the state donated round to round.
+Replay (serve/trace.py) builds its step through this SAME function with
+the same config, which is what makes live-vs-replay bit-identity hold:
+same XLA program, same deterministic integer ops, same batches.
+
+Between rounds the driver refreshes a plain-dict snapshot the frontend
+serves to ``QUERY`` clients — liveness/coverage/reliability derived
+from the steady-state metrics, one round stale by construction (the
+price of the overlap, and exactly the staleness ``pipeline`` depth 1
+charges the exchange).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from tpu_gossip.traffic.ingest import IngestPlan, make_batch
+from tpu_gossip.serve.trace import ServeTrace, TraceRecorder
+
+__all__ = ["DriverReport", "ServeDriver", "build_step", "stack_round_stats"]
+
+
+def build_step(
+    cfg,
+    plan=None,
+    *,
+    mesh=None,
+    tail: str = "fused",
+    scenario=None,
+    growth=None,
+    stream=None,
+    control=None,
+    liveness=None,
+):
+    """ONE jitted ``step(state, batch) -> (state, stats)`` for a run.
+
+    ``mesh=None`` builds the local engine's round (packed included —
+    ``gossip_round`` dispatches on the state's carry); a mesh builds the
+    sharded matching round. The state is donated: the driver holds only
+    the current round's state, and replay does the same.
+    """
+    import jax
+
+    if mesh is not None:
+        from tpu_gossip.dist.matching_mesh import gossip_round_dist_matching
+
+        def raw(state, batch):
+            return gossip_round_dist_matching(
+                state, cfg, plan, mesh, scenario, growth, None, False,
+                stream, control, None, liveness, inject=batch,
+            )
+    else:
+        from tpu_gossip.sim.engine import gossip_round
+
+        def raw(state, batch):
+            return gossip_round(
+                state, cfg, plan, tail=tail, scenario=scenario,
+                growth=growth, stream=stream, control=control,
+                liveness=liveness, inject=batch,
+            )
+
+    return jax.jit(raw, donate_argnums=(0,))
+
+
+def stack_round_stats(per_round: list):
+    """Host-stacked RoundStats: R scalars per field -> one (R,) array per
+    field — the shape every metrics report consumes."""
+    if not per_round:
+        raise ValueError("no rounds recorded")
+    cls = type(per_round[0])
+    return cls(*[
+        np.stack([np.asarray(getattr(s, f)) for s in per_round])
+        for f in cls._fields
+    ])
+
+
+class DriverReport(NamedTuple):
+    """What a serving run hands back to the CLI."""
+
+    state: object  # final device state
+    stats: object  # host-stacked RoundStats, fields shaped (R,)
+    trace: ServeTrace
+    wall_seconds: float
+    rounds: int
+
+
+class ServeDriver:
+    """Run R round windows against a frontend; record the trace."""
+
+    def __init__(
+        self,
+        step,
+        state,
+        frontend,
+        ingest_plan: IngestPlan,
+        *,
+        rounds: int,
+        rounds_per_sec: float = 0.0,  # 0 = unpaced (as fast as the device)
+        coverage_target: float = 0.99,
+    ):
+        if rounds <= 0:
+            raise ValueError("serving runs a fixed horizon: rounds >= 1")
+        self.step = step
+        self.state = state
+        self.frontend = frontend
+        self.ingest_plan = ingest_plan
+        self.rounds = int(rounds)
+        self.period = 1.0 / rounds_per_sec if rounds_per_sec > 0 else 0.0
+        self.coverage_target = coverage_target
+        self.recorder = TraceRecorder(ingest_plan)
+        self._snapshot: dict = {"round": -1}
+        self._per_round: list = []
+
+    def snapshot(self) -> dict:
+        """The frontend's QUERY view — replaced wholesale per absorb, so
+        a reader thread always sees one consistent dict."""
+        return self._snapshot
+
+    def _absorb(self, host_stats, rnd: int) -> None:
+        self._per_round.append(host_stats)
+        n_alive = max(int(np.asarray(host_stats.n_alive)), 1)
+        self._snapshot = {
+            "round": rnd,
+            "coverage": float(np.asarray(host_stats.coverage)),
+            "n_alive": int(np.asarray(host_stats.n_alive)),
+            "n_infected": int(np.asarray(host_stats.n_infected)),
+            "n_declared_dead": int(np.asarray(host_stats.n_declared_dead)),
+            "infected_frac": float(np.asarray(host_stats.n_infected)) / n_alive,
+            "ingest_offered": int(np.asarray(host_stats.ingest_offered)),
+            "ingest_injected": int(np.asarray(host_stats.ingest_injected)),
+            "ingest_overflow": int(np.asarray(host_stats.ingest_overflow)),
+            "backlog": self.frontend.backlog(),
+        }
+
+    def run(self) -> DriverReport:
+        import jax
+
+        t0 = time.monotonic()
+        next_deadline = t0
+        in_flight: Optional[tuple] = None  # (rnd, device stats)
+        for r in range(self.rounds):
+            window, overflow = self.frontend.take_window()
+            batch = make_batch(
+                self.ingest_plan,
+                [o for o, _ in window],
+                [h for _, h in window],
+                overflow=overflow,
+            )
+            self.recorder.record_round(r, window, overflow)
+            # dispatch round r, THEN drain round r-1 — the host blocks
+            # on last round's scalars while the device runs this one
+            self.state, stats_dev = self.step(self.state, batch)
+            if in_flight is not None:
+                prev_r, prev_stats = in_flight
+                self._absorb(jax.device_get(prev_stats), prev_r)
+            in_flight = (r, stats_dev)
+            if self.period > 0.0:
+                next_deadline += self.period
+                delay = next_deadline - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+        prev_r, prev_stats = in_flight
+        self._absorb(jax.device_get(prev_stats), prev_r)
+        wall = time.monotonic() - t0
+        return DriverReport(
+            state=self.state,
+            stats=stack_round_stats(self._per_round),
+            trace=self.recorder.finish(),
+            wall_seconds=wall,
+            rounds=self.rounds,
+        )

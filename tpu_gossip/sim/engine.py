@@ -114,11 +114,21 @@ class RoundStats(NamedTuple):
     dead_undeclared: jax.Array  # i32 — members dead but not yet declared
     adv_accusations: jax.Array  # i32 — false dead-verdicts this round
     adv_forged: jax.Array  # i32 — forged heartbeats this round
+    # live-ingestion track (serve/ + traffic/ingest.py) — all 0 unless a
+    # serving frontend feeds the round an InjectBatch (absent subsystems
+    # cost nothing, counters included). ingest_overflow bills arrivals
+    # deferred past a round window's static batch (carried, not dropped)
+    # — the saturation signal the serve-smoke CI job pins to 0.
+    ingest_offered: jax.Array  # i32 — live arrivals presented this round
+    ingest_injected: jax.Array  # i32 — of those, landed (live origin, not FP)
+    ingest_conflated: jax.Array  # i32 — k=1 conflations / k>=2 Bloom-FP drops
+    ingest_overflow: jax.Array  # i32 — arrivals deferred to the next window
 
 
 def _stats(
     state: SwarmState, msgs_sent: jax.Array, fstats=None, growth=None,
     stream=None, stel=None, ctel=None, ltel=None, liveness=None,
+    itel=None,
 ) -> RoundStats:
     live = state.alive & ~state.declared_dead
     z = jnp.zeros((), dtype=jnp.int32)
@@ -187,6 +197,10 @@ def _stats(
         ),
         adv_accusations=z if ltel is None else ltel.adv_accusations,
         adv_forged=z if ltel is None else ltel.adv_forged,
+        ingest_offered=z if itel is None else itel.offered,
+        ingest_injected=z if itel is None else itel.injected,
+        ingest_conflated=z if itel is None else itel.conflated,
+        ingest_overflow=z if itel is None else itel.overflow,
     )
 
 
@@ -832,6 +846,7 @@ def advance_round(
     forge_width: int = 0,
     k_accuse: jax.Array | None = None,
     k_forge: jax.Array | None = None,
+    inject=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Everything after dissemination: dedup-merge, SIR, liveness, churn,
     growth admission, streaming age-out + injection, adaptive control.
@@ -940,7 +955,7 @@ def advance_round(
         "held": state.fault_held if fault_held is None else fault_held,
         # defaults the optional stages overwrite
         "fresh": None, "expired": None, "stel": None, "ctel": None,
-        "ltel": None,
+        "ltel": None, "itel": None, "inject": inject,
     }
     values = run_stages(
         build_round_stages(
@@ -948,7 +963,7 @@ def advance_round(
             churn_faults=churn_faults, growth=growth, stream=stream,
             control=control, liveness=liveness,
             has_accusers=has_accusers, has_forgers=has_forgers,
-            forge_width=forge_width,
+            forge_width=forge_width, ingest=inject is not None,
         ),
         values,
     )
@@ -991,13 +1006,13 @@ def advance_round(
     )
     return new_state, _stats(new_state, msgs_sent, fstats, growth, stream,
                              values["stel"], values["ctel"],
-                             values["ltel"], liveness)
+                             values["ltel"], liveness, values["itel"])
 
 
 def gossip_round(
     state: SwarmState, cfg: SwarmConfig, plan=None, *, tail: str = "fused",
     scenario=None, growth=None, stream=None, control=None, pipeline=None,
-    liveness=None,
+    liveness=None, inject=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Advance the swarm one round. Pure; jit-able with ``cfg`` static.
 
@@ -1055,6 +1070,13 @@ def gossip_round(
     ``quorum_k=1`` under no adversaries — reproduce the historical
     detector's trajectory bit for bit.
 
+    ``inject`` (a :class:`~tpu_gossip.traffic.InjectBatch`) lands the
+    serving frontend's live arrivals post-tail (traffic/ingest.py):
+    deterministic host data, no randomness consumed — ``inject=None``
+    and a zero-count batch reproduce the uninjected trajectory bit for
+    bit, and replaying a recorded batch sequence reproduces a live
+    serving run exactly (serve/trace.py's contract).
+
     A :class:`~tpu_gossip.core.packed.PackedSwarm` input runs the
     packed-NATIVE round (``sim.packed_engine``): the hot stages compute
     directly on the uint8 bit words and full width exists only at the
@@ -1071,7 +1093,7 @@ def gossip_round(
         return gossip_round_packed(
             state, cfg, plan, tail=tail, scenario=scenario, growth=growth,
             stream=stream, control=control, pipeline=pipeline,
-            liveness=liveness,
+            liveness=liveness, inject=inject,
         )
 
     def disseminate(tx, tr, rc, kp, kq, rctl):
@@ -1080,7 +1102,7 @@ def gossip_round(
     return run_protocol_round(
         state, cfg, disseminate, tail=tail, scenario=scenario,
         growth=growth, stream=stream, control=control, pipeline=pipeline,
-        liveness=liveness,
+        liveness=liveness, inject=inject,
     )
 
 
@@ -1092,7 +1114,7 @@ def gossip_round(
 def simulate(
     state: SwarmState, cfg: SwarmConfig, num_rounds: int, plan=None,
     tail: str = "fused", scenario=None, growth=None, stream=None,
-    control=None, pipeline=None, liveness=None,
+    control=None, pipeline=None, liveness=None, inject=None,
 ) -> tuple[SwarmState, RoundStats]:
     """Run a fixed horizon of rounds; returns final state + stacked per-round
     stats (each field shaped (num_rounds,)) — the coverage-vs-round curve.
@@ -1123,14 +1145,20 @@ def simulate(
     unpacked one (test-pinned across the composed
     scenario×growth×stream×control×pipeline×adversary matrix). The
     return is packed too; ``unpack_state`` reads it.
+
+    ``inject`` threads a STACKED :class:`~tpu_gossip.traffic.
+    InjectBatch` (leading ``num_rounds`` axis) through the scan as its
+    xs — the whole-run replay path for a recorded live-serving trace
+    (serve/trace.py); ``None`` runs uninjected.
     """
 
-    def body(carry, _):
+    def body(carry, batch):
         return gossip_round(carry, cfg, plan, tail=tail, scenario=scenario,
                             growth=growth, stream=stream, control=control,
-                            pipeline=pipeline, liveness=liveness)
+                            pipeline=pipeline, liveness=liveness,
+                            inject=batch)
 
-    return jax.lax.scan(body, state, None, length=num_rounds)
+    return jax.lax.scan(body, state, inject, length=num_rounds)
 
 
 @functools.partial(

@@ -273,6 +273,37 @@ def _stream_inject_stage_packed(stream, m: int):
     return Stage("stream_inject", reads, writes, fn)
 
 
+def _ingest_stage_packed(m: int):
+    """Word twin of ``sim.stages._ingest_stage``: ``apply_arrivals``
+    genuinely writes an (N, M) plane (slot scatter), so live ingestion
+    decodes the seen words at this boundary and repacks the product —
+    exactly the stream-inject license."""
+    from tpu_gossip.sim.stages import Stage
+
+    reads = (
+        "rnd", "inject", "seen", "infected_round", "slot_lease",
+        "exists", "alive", "declared_dead",
+    )
+    writes = ("seen", "infected_round", "slot_lease", "itel")
+
+    def fn(ctx):
+        from tpu_gossip.traffic.ingest import apply_arrivals
+
+        seen, infected_round, slot_lease, itel = apply_arrivals(
+            ctx["inject"], ctx["rnd"],
+            seen=unpack_bits(ctx["seen"], m),
+            infected_round=ctx["infected_round"],
+            slot_lease=ctx["slot_lease"], exists=ctx["exists"],
+            alive=ctx["alive"], declared_dead=ctx["declared_dead"],
+        )
+        return {
+            "seen": pack_bits(seen), "infected_round": infected_round,
+            "slot_lease": slot_lease, "itel": itel,
+        }
+
+    return Stage("ingest", reads, writes, fn)
+
+
 def _control_stage_packed(cfg, control, m: int):
     """``apply_control`` reads three genuine (N, M) bool planes (the
     duplicate counter compares delivery against both seen epochs), so the
@@ -324,6 +355,7 @@ def _build_round_stages_packed(
     has_accusers: bool = False,
     has_forgers: bool = False,
     forge_width: int = 0,
+    ingest: bool = False,
 ):
     """The packed stage DAG: same order, same membership rules as
     ``sim.stages.build_round_stages``. Row-level stages are SHARED with
@@ -348,6 +380,8 @@ def _build_round_stages_packed(
     stages.append(_tail_stage_packed(cfg, tail, m))
     if stream is not None:
         stages.append(_stream_inject_stage_packed(stream, m))
+    if ingest:
+        stages.append(_ingest_stage_packed(m))
     if control is not None:
         stages.append(_control_stage_packed(cfg, control, m))
     return tuple(stages)
@@ -382,6 +416,7 @@ def advance_round_packed(
     forge_width: int = 0,
     k_accuse: jax.Array | None = None,
     k_forge: jax.Array | None = None,
+    inject=None,
 ):
     """Word twin of ``sim.engine.advance_round``: the same declared-carry
     stage run, with the slot planes riding as (N, W) words under their
@@ -416,7 +451,7 @@ def advance_round_packed(
         "held": ps.fault_held if fault_held_w is None else fault_held_w,
         # defaults the optional stages overwrite
         "fresh": None, "expired": None, "stel": None, "ctel": None,
-        "ltel": None,
+        "ltel": None, "itel": None, "inject": inject,
     }
     values = run_stages(
         _build_round_stages_packed(
@@ -424,7 +459,7 @@ def advance_round_packed(
             churn_faults=churn_faults, growth=growth, stream=stream,
             control=control, liveness=liveness,
             has_accusers=has_accusers, has_forgers=has_forgers,
-            forge_width=forge_width,
+            forge_width=forge_width, ingest=inject is not None,
         ),
         values,
     )
@@ -459,13 +494,14 @@ def advance_round_packed(
     return new_state, _stats_packed(
         new_state, values, msgs_sent, fstats, growth, stream,
         values["stel"], values["ctel"], values["ltel"], liveness,
+        values["itel"],
     )
 
 
 def _stats_packed(
     ps: PackedSwarm, values: dict, msgs_sent: jax.Array, fstats=None,
     growth=None, stream=None, stel=None, ctel=None, ltel=None,
-    liveness=None,
+    liveness=None, itel=None,
 ):
     """Word twin of ``sim.engine._stats``: the same RoundStats, with the
     full-width boolean sums replaced by popcounts / bit-column reads.
@@ -540,6 +576,10 @@ def _stats_packed(
         ),
         adv_accusations=z if ltel is None else ltel.adv_accusations,
         adv_forged=z if ltel is None else ltel.adv_forged,
+        ingest_offered=z if itel is None else itel.offered,
+        ingest_injected=z if itel is None else itel.injected,
+        ingest_conflated=z if itel is None else itel.conflated,
+        ingest_overflow=z if itel is None else itel.overflow,
     )
 
 
@@ -556,6 +596,7 @@ def run_protocol_round_packed(
     control=None,
     pipeline=None,
     liveness=None,
+    inject=None,
 ):
     """Word twin of ``sim.stages.run_protocol_round`` — same driver, same
     split/fold sequence, engine-agnostic.
@@ -645,7 +686,7 @@ def run_protocol_round_packed(
         churn_faults=scenario is not None and scenario.has_churn,
         fault_held_w=held_w, fstats=telem, growth=growth, stream=stream,
         control=control, rctl=rctl, pipe_buf_w=pipe_buf_w,
-        liveness=liveness,
+        liveness=liveness, inject=inject,
         has_accusers=scenario is not None and scenario.has_accusers,
         has_forgers=scenario is not None and scenario.has_forgers,
         forge_width=scenario.max_forge_fanout if scenario is not None else 0,
@@ -656,7 +697,7 @@ def run_protocol_round_packed(
 def gossip_round_packed(
     ps: PackedSwarm, cfg, plan=None, *, tail: str = "fused",
     scenario=None, growth=None, stream=None, control=None, pipeline=None,
-    liveness=None,
+    liveness=None, inject=None,
 ):
     """Advance a packed swarm one round, natively on the words — the
     dispatch target ``sim.engine.gossip_round`` routes ``PackedSwarm``
@@ -681,5 +722,5 @@ def gossip_round_packed(
     return run_protocol_round_packed(
         ps, cfg, deliver_words, deliver_bool_factory, tail=tail,
         scenario=scenario, growth=growth, stream=stream, control=control,
-        pipeline=pipeline, liveness=liveness,
+        pipeline=pipeline, liveness=liveness, inject=inject,
     )

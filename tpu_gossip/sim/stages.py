@@ -606,6 +606,37 @@ def _stream_inject_stage(stream) -> Stage:
     return Stage("stream_inject", reads, writes, fn)
 
 
+def _ingest_stage() -> Stage:
+    """Live-arrival injection (traffic/ingest.py), post-tail like the
+    stream stage: a round-r arrival first transmits in round r+1, and
+    origins are gated on the round's FINAL liveness. Runs AFTER
+    stream_inject so synthetic and live traffic compose — the stream's
+    draws are untouched (ingest consumes no randomness) and both share
+    the one lease table. The batch rides the carry dict (``inject``):
+    traced per-round data, not trace structure."""
+    reads = (
+        "rnd", "inject", "seen", "infected_round", "slot_lease",
+        "exists", "alive", "declared_dead",
+    )
+    writes = ("seen", "infected_round", "slot_lease", "itel")
+
+    def fn(ctx):
+        from tpu_gossip.traffic.ingest import apply_arrivals
+
+        seen, infected_round, slot_lease, itel = apply_arrivals(
+            ctx["inject"], ctx["rnd"],
+            seen=ctx["seen"], infected_round=ctx["infected_round"],
+            slot_lease=ctx["slot_lease"], exists=ctx["exists"],
+            alive=ctx["alive"], declared_dead=ctx["declared_dead"],
+        )
+        return {
+            "seen": seen, "infected_round": infected_round,
+            "slot_lease": slot_lease, "itel": itel,
+        }
+
+    return Stage("ingest", reads, writes, fn)
+
+
 def _control_stage(cfg, control) -> Stage:
     """Adaptive control (control/), LAST: the AIMD level update reads the
     round's final liveness/lease tables and the PeerSwap refresh acts on
@@ -652,6 +683,7 @@ def build_round_stages(
     has_accusers: bool = False,
     has_forgers: bool = False,
     forge_width: int = 0,
+    ingest: bool = False,
 ) -> tuple[Stage, ...]:
     """The post-dissemination stage DAG for one config (trace-time).
 
@@ -682,6 +714,8 @@ def build_round_stages(
     stages.append(_tail_stage(cfg, tail))
     if stream is not None:
         stages.append(_stream_inject_stage(stream))
+    if ingest:
+        stages.append(_ingest_stage())
     if control is not None:
         stages.append(_control_stage(cfg, control))
     return tuple(stages)
@@ -714,6 +748,7 @@ def run_protocol_round(
     control=None,
     pipeline: PipelineSpec | None = None,
     liveness=None,
+    inject=None,
 ):
     """One whole protocol round, engine-agnostic: the shared driver.
 
@@ -737,6 +772,12 @@ def run_protocol_round(
     packet arriving after its receiver died or recovered is dropped —
     ordinary network semantics). ``depth == 0`` (and ``pipeline=None``)
     is the serial schedule, bit for bit.
+
+    ``inject`` (a :class:`~tpu_gossip.traffic.InjectBatch`) lands the
+    serving frontend's host-batched live arrivals post-tail
+    (traffic/ingest.py) — deterministic data, no randomness consumed,
+    so ``inject=None`` and a zero-count batch reproduce the uninjected
+    trajectory bit for bit.
     """
     from tpu_gossip.sim import engine as _engine
 
@@ -809,7 +850,7 @@ def run_protocol_round(
         churn_faults=scenario is not None and scenario.has_churn,
         fault_held=held, fstats=telem, growth=growth, stream=stream,
         control=control, rctl=rctl, pipe_buf=pipe_buf,
-        liveness=liveness,
+        liveness=liveness, inject=inject,
         has_accusers=scenario is not None and scenario.has_accusers,
         has_forgers=scenario is not None and scenario.has_forgers,
         forge_width=scenario.max_forge_fanout if scenario is not None else 0,

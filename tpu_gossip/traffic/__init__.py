@@ -8,6 +8,11 @@ shared ``sim.engine.advance_round`` on every delivery engine. The
 injection draws come from the registered ``TRAFFIC_STREAM_SALT`` stream
 (core/streams.py) at global shape, so the local ↔ sharded bit-identity
 contract extends to loaded swarms.
+
+``apply_arrivals`` (traffic/ingest.py) is the deterministic twin fed by
+the live serving frontend (serve/): host-batched REAL arrivals land with
+the same lease/Bloom semantics but zero randomness, so a recorded trace
+replays bit for bit.
 """
 
 from tpu_gossip.traffic.engine import (
@@ -15,6 +20,15 @@ from tpu_gossip.traffic.engine import (
     StreamTelemetry,
     apply_stream,
     slot_expiry,
+)
+from tpu_gossip.traffic.ingest import (
+    IngestError,
+    IngestPlan,
+    IngestTelemetry,
+    InjectBatch,
+    apply_arrivals,
+    empty_batch,
+    make_batch,
 )
 from tpu_gossip.traffic.plan import (
     ORIGIN_LAWS,
@@ -30,6 +44,13 @@ __all__ = [
     "StreamTelemetry",
     "apply_stream",
     "slot_expiry",
+    "IngestError",
+    "IngestPlan",
+    "IngestTelemetry",
+    "InjectBatch",
+    "apply_arrivals",
+    "empty_batch",
+    "make_batch",
     "ORIGIN_LAWS",
     "CompiledStream",
     "StreamError",

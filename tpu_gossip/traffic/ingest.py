@@ -1,0 +1,241 @@
+"""Deterministic arrival injection: the serving frontend's device half.
+
+The streaming plane (``apply_stream``) *synthesizes* traffic from a
+registered PRNG stream. The serving plane (serve/) receives REAL
+traffic over sockets: the host frontend batches each round window's
+accepted arrivals into static-shape tensors — an :class:`InjectBatch` —
+and :func:`apply_arrivals` lands them with EXACTLY the streaming
+engine's per-message semantics (sequential landing over the lease
+table, k=1 conflation / k>=2 Bloom suppression, post-tail bit sets) but
+ZERO randomness: origins and slots are data, not draws. The batch is
+therefore the whole injection — replaying a recorded sequence of
+batches through this function reproduces the live run bit for bit
+(serve/trace.py's contract), and a zero-``count`` batch is a masked
+no-op whose trajectory is bit-identical to ``inject=None``.
+
+Static shapes: every batch carries ``max_inject`` rows regardless of
+the traced ``count`` (entries past ``count`` are dead — masked out, not
+read). Arrivals beyond ``max_inject`` in one round window are NEVER
+dropped by the engine: the frontend carries them into the next window
+and bills them to ``overflow`` so saturation is visible in RoundStats
+(``ingest_overflow``), not silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_gossip.core.state import message_slots, saturate_round
+
+__all__ = [
+    "IngestError",
+    "IngestPlan",
+    "InjectBatch",
+    "IngestTelemetry",
+    "empty_batch",
+    "make_batch",
+    "apply_arrivals",
+]
+
+
+class IngestError(ValueError):
+    """An ingest config that cannot mean what it says (compile time)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestPlan:
+    """Static shape contract between the host frontend and the device
+    injection stage: every round's batch is ``(max_inject,)`` origins ×
+    ``(max_inject, k_hashes)`` slots, so ONE compile serves the whole
+    serving session. ``k_hashes`` follows the streaming plane's Bloom
+    semantics (k=1 conflates on a live lease, k>=2 suppresses only when
+    all k slots are leased)."""
+
+    msg_slots: int
+    max_inject: int
+    k_hashes: int = 1
+
+    def __post_init__(self):
+        if self.max_inject < 1:
+            raise IngestError(f"max_inject={self.max_inject} must be >= 1")
+        if not (1 <= self.k_hashes <= self.msg_slots):
+            raise IngestError(
+                f"k_hashes={self.k_hashes} outside [1, msg_slots="
+                f"{self.msg_slots}] — the Bloom planes live in the slot "
+                "dimension"
+            )
+
+
+class InjectBatch(NamedTuple):
+    """One round window's accepted arrivals, at static shape (traced).
+
+    ``origins`` are STATE ROWS (the engine's layout — sharded callers
+    map peer ids through their plan's ``to_rows`` before batching);
+    ``slots`` are each message's ``k`` hash slots (host-side
+    :func:`~tpu_gossip.core.state.message_slots` over the payload hash,
+    so live ingestion and pure-sim replay agree by construction).
+    Entries at index >= ``count`` are dead padding. ``overflow`` bills
+    arrivals the window could not fit (carried to the next batch by the
+    frontend, never dropped).
+    """
+
+    origins: jax.Array  # (j,) int32 — state rows, dead entries 0
+    slots: jax.Array  # (j, k) int32 — hash slots, dead entries 0
+    count: jax.Array  # () int32 — live entries this round
+    overflow: jax.Array  # () int32 — arrivals deferred to the next window
+
+
+class IngestTelemetry(NamedTuple):
+    """Per-round ingest counters for RoundStats (all scalar int32)."""
+
+    offered: jax.Array  # arrivals presented to the device this round
+    injected: jax.Array  # arrivals that landed (live origin, not suppressed)
+    conflated: jax.Array  # k=1: landed on a live lease; k>=2: Bloom-FP suppressed
+    overflow: jax.Array  # arrivals deferred past this round's window
+
+
+def empty_batch(plan: IngestPlan) -> InjectBatch:
+    """The zero-arrival batch — the quiescent round's injection input.
+    Landing it is bit-identical to ``inject=None`` (test-pinned)."""
+    j, k = plan.max_inject, plan.k_hashes
+    return InjectBatch(
+        origins=jnp.zeros((j,), dtype=jnp.int32),
+        slots=jnp.zeros((j, k), dtype=jnp.int32),
+        count=jnp.zeros((), dtype=jnp.int32),
+        overflow=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def make_batch(
+    plan: IngestPlan,
+    origins,
+    payload_hashes,
+    *,
+    overflow: int = 0,
+) -> InjectBatch:
+    """Host-side batch builder: pad ``origins``/``payload_hashes`` (one
+    per accepted arrival, arrival order — landing is sequential, so
+    order is part of the trace) to the plan's static shape. Callers
+    with more than ``max_inject`` arrivals split the excess into the
+    NEXT window themselves and bill it here as ``overflow``."""
+    origins = np.asarray(origins, dtype=np.int64)
+    hashes = list(payload_hashes)
+    if origins.ndim != 1 or origins.shape[0] != len(hashes):
+        raise IngestError(
+            f"origins {origins.shape} and payload_hashes ({len(hashes)}) "
+            "must be parallel 1-D sequences"
+        )
+    n_arr = origins.shape[0]
+    if n_arr > plan.max_inject:
+        raise IngestError(
+            f"{n_arr} arrivals exceed max_inject={plan.max_inject}; carry "
+            "the excess into the next window and bill it as overflow="
+        )
+    j, k = plan.max_inject, plan.k_hashes
+    o = np.zeros(j, dtype=np.int32)
+    o[:n_arr] = origins
+    s = np.zeros((j, k), dtype=np.int32)
+    for i, h in enumerate(hashes):
+        s[i] = message_slots(h, plan.msg_slots, k)
+    return InjectBatch(
+        origins=jnp.asarray(o),
+        slots=jnp.asarray(s),
+        count=jnp.asarray(n_arr, dtype=jnp.int32),
+        overflow=jnp.asarray(int(overflow), dtype=jnp.int32),
+    )
+
+
+def apply_arrivals(
+    batch: InjectBatch,
+    rnd: jax.Array,
+    *,
+    seen: jax.Array,
+    infected_round: jax.Array,
+    slot_lease: jax.Array,
+    exists: jax.Array,
+    alive: jax.Array,
+    declared_dead: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, IngestTelemetry]:
+    """Land one round window's arrivals; returns (seen, infected_round,
+    slot_lease, telemetry).
+
+    The deterministic twin of :func:`~tpu_gossip.traffic.engine.
+    apply_stream`'s landing half — SAME sequential lease scan, SAME
+    conflation/Bloom rules, SAME saturated int16 lease writes — with the
+    draws replaced by the batch's data. Consumes NO randomness (no salt,
+    no fold), so composing it with any stochastic plane moves no
+    existing stream. Runs AFTER the fused tail and the row stages, so
+    origins are gated on the round's FINAL liveness (a client whose
+    mapped peer is down this round is offered-but-not-injected — exactly
+    a user knocking on a dead peer) and a round-r arrival first
+    transmits in round r+1.
+    """
+    n = exists.shape[0]
+    j, k = batch.slots.shape
+
+    live = jnp.arange(j) < batch.count
+    safe_o = jnp.clip(batch.origins, 0, n - 1)
+    ok = (
+        live
+        & exists[safe_o]
+        & alive[safe_o]
+        & ~declared_dead[safe_o]
+    )
+
+    # sequential landing over the batch — arrival i+1 sees the leases
+    # arrival i took, the per-message semantics the closed-form
+    # predictors (sim.metrics) assume; the scan carries only the (M,)
+    # lease table
+    def land(lease, x):
+        sl, ok_i = x  # (k,) int32, scalar bool
+        cur = lease[sl]
+        leased = cur >= 0
+        if k == 1:
+            suppressed = jnp.zeros((), dtype=bool)
+            conf = ok_i & leased[0]
+        else:
+            all_leased = jnp.all(leased)
+            suppressed = all_leased
+            conf = ok_i & all_leased
+        landed = ok_i & ~suppressed
+        # free slots among the message's k take the lease; live leases
+        # keep their (older, smaller) round under max — saturated at
+        # ROUND_CAP like every round-valued int16 plane write
+        contrib = jnp.where(
+            landed & ~leased, saturate_round(rnd, lease.dtype), -1
+        ).astype(lease.dtype)
+        lease = lease.at[sl].max(contrib)
+        return lease, (landed, conf)
+
+    slot_lease, (landed, conflated) = jax.lax.scan(
+        land, slot_lease, (batch.slots, ok)
+    )
+
+    rows = jnp.where(landed, safe_o, n)
+    inj = (
+        jnp.zeros_like(seen)
+        .at[
+            jnp.broadcast_to(rows[:, None], (j, k)).reshape(-1),
+            batch.slots.reshape(-1),
+        ]
+        .set(True, mode="drop")
+    )
+    seen = seen | inj
+    infected_round = jnp.where(
+        inj & (infected_round < 0),
+        saturate_round(rnd, infected_round.dtype),
+        infected_round,
+    )
+
+    telem = IngestTelemetry(
+        offered=batch.count.astype(jnp.int32),
+        injected=jnp.sum(landed, dtype=jnp.int32),
+        conflated=jnp.sum(conflated, dtype=jnp.int32),
+        overflow=batch.overflow.astype(jnp.int32),
+    )
+    return seen, infected_round, slot_lease, telem
