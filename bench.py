@@ -1836,7 +1836,7 @@ def _ici_summary(ici) -> dict:
     o = np.asarray(ici.occupied_words).astype(np.int64)
     lanes = np.asarray(ici.sparse_lanes).astype(np.int64)
     total = np.asarray(ici.total_lanes).astype(np.int64)
-    return {
+    out = {
         "rounds": int(len(d)),
         "dense_bytes_per_round": int(d.mean()) * 4,
         "shipped_bytes_per_round_mean": int(s.mean()) * 4,
@@ -1851,6 +1851,26 @@ def _ici_summary(ici) -> dict:
         "sparse_lane_rounds": int(((total > 0) & (lanes == total)).sum()),
         "gated_rounds": int((total > 0).sum()),
     }
+    # per-interconnect columns (2-D cluster meshes only): the trajectory's
+    # dcn_* fields carry the cross-host share, ici = total - dcn — the
+    # same split run_sim's summary and the collectives.lock columns use,
+    # so the three artifacts pin each other
+    dd = np.asarray(ici.dcn_dense_words).astype(np.int64)
+    ds = np.asarray(ici.dcn_shipped_words).astype(np.int64)
+    if dd.sum() or ds.sum():
+        for key, dn, sh in (("ici_bytes", d - dd, s - ds),
+                            ("dcn_bytes", dd, ds)):
+            out[key] = {
+                "dense_per_round": int(dn.mean()) * 4,
+                "shipped_per_round_mean": int(sh.mean()) * 4,
+                "reduction_vs_dense_mean": round(
+                    float(dn.sum() / max(sh.sum(), 1)), 3
+                ),
+                "reduction_vs_dense_round1": round(
+                    float(dn[0] / max(sh[0], 1)), 3
+                ),
+            }
+    return out
 
 
 def bench_dist_matching(n: int, reps: int = 3):
@@ -1954,6 +1974,107 @@ def bench_dist_matching(n: int, reps: int = 3):
         "note": "identical plan + RNG stream on both engines → bit-identical"
         " trajectories; the per-round delta is pure shard_map/collective"
         " transport (transposes as dense all_to_all), not sampling noise",
+    }
+
+
+def bench_hier_1m(n: int, reps: int = 1):
+    """1M matching on the (2, D/2) cluster mesh: the flat (dense
+    cross-host) exchange vs the two-level ICI/DCN transport
+    (cluster/hier.py) — DCN bytes/round and ms/round for both.
+
+    The headline figure is ``dcn_reduction_vs_flat_round1``: dense
+    cross-host words / compacted cross-host words in the early phase,
+    from the analytic per-axis trajectory (the same counters the traced
+    wire audit pins) — the flat transport's tracked
+    ``reduction_vs_dense_round1`` standard (docs/sparse_exchange.md),
+    one interconnect level up. The horizon mean rides beside it and
+    saturates under push_pull (the pull-answer plane is real occupancy,
+    not compressible). On this CPU-only container both mesh axes are
+    host RAM,
+    so the ms/round delta measures collective re-plumbing, NOT a real
+    DCN round-trip — the byte columns are the platform-independent
+    metric; only a real multi-host run prices the latency win.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_gossip.cluster import make_cluster_mesh
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+    from tpu_gossip.core.state import SwarmConfig, clone_state, init_swarm
+    from tpu_gossip.dist import (
+        build_transport, run_until_coverage_dist, shard_matching_plan,
+        shard_swarm, simulate_dist,
+    )
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2 or 128 % n_dev:
+        return {
+            "n_peers": n, "devices": n_dev,
+            "unsupported": f"{n_dev} device(s) cannot fold to a (2, D/2) "
+            "mesh compatible with the matching 128-lane split",
+        }
+    mesh = make_cluster_mesh(hosts=2)
+    t0 = time.perf_counter()
+    g, plan = matching_powerlaw_graph_sharded(
+        n, mesh.size, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    int(jnp.sum(plan.valid))
+    build_s = time.perf_counter() - t0
+    plan_m = shard_matching_plan(plan, mesh)
+    cfg = SwarmConfig(n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull")
+    st0 = init_swarm(
+        g.as_padded_graph(), cfg, origins=np.arange(cfg.msg_slots),
+        origin_slots=np.arange(cfg.msg_slots), exists=g.exists,
+        key=jax.random.key(0),
+    )
+    st = shard_swarm(st0, mesh)
+    flat = _timed_coverage(
+        lambda s: run_until_coverage_dist(s, cfg, plan_m, mesh, 0.99, 300),
+        st, n, reps,
+    )
+    transport = build_transport(plan, mode="hier", hosts=2)
+    hier = _timed_coverage(
+        lambda s: run_until_coverage_dist(s, cfg, plan_m, mesh, 0.99, 300,
+                                          transport=transport),
+        st, n, reps,
+    )
+    # identical trajectory (the transport reorders bytes, never draws):
+    # the untimed replay's analytic trajectory prices both stages
+    _, (_stats, ici) = simulate_dist(
+        clone_state(st), cfg, plan_m, mesh, max(flat["rounds"], 1), None,
+        None, None, transport, True,
+    )
+    dd = np.asarray(ici.dcn_dense_words).astype(np.int64)
+    ds = np.asarray(ici.dcn_shipped_words).astype(np.int64)
+    return {
+        "n_peers": n, "devices": mesh.size, "hosts": 2,
+        "msg_slots": cfg.msg_slots,
+        "build_seconds": round(build_s, 2),
+        "flat": flat, "hier": hier,
+        "dcn_bytes_per_round": {
+            "flat_dense": int(dd.mean()) * 4,
+            "hier_shipped_mean": int(ds.mean()) * 4,
+            "hier_shipped_round1": int(ds[0]) * 4,
+        },
+        # round-1 is the tracked early-phase success metric, same standard
+        # as the flat transport's reduction_vs_dense_round1 (>= 3x at 1M,
+        # docs/sparse_exchange.md); the horizon mean saturates under
+        # push_pull because the pull-answer plane is real occupancy, not
+        # compressible — recorded beside it, not hidden
+        "dcn_reduction_vs_flat_round1": round(
+            float(dd[0] / max(ds[0], 1)), 3
+        ),
+        "dcn_reduction_vs_flat_mean": round(
+            float(dd.sum() / max(ds.sum(), 1)), 3
+        ),
+        "ici_bytes_per_round": _ici_summary(ici),
+        "note": "CPU-only container: both axes are host RAM, so ms/round "
+        "deltas price collective plumbing, not DCN latency — the per-axis "
+        "byte columns are the platform-independent metric",
     }
 
 
@@ -2169,7 +2290,8 @@ def main(argv: list[str] | None = None) -> int:
         """True (and records the skip) when the budget is too spent for
         ``section`` — the guard that keeps rc=0 with the headline printed."""
         frac = {"tail_ab": 0.35, "north_star_10m": 0.40, "dist_200k": 0.70,
-                "dist_1m": 0.78, "packed_ab_1m": 0.80, "grow_1m": 0.82,
+                "dist_1m": 0.78, "hier_1m": 0.79,
+                "packed_ab_1m": 0.80, "grow_1m": 0.82,
                 "stream_1m": 0.86, "serve_1m": 0.87,
                 "control_1m": 0.88, "adv_1m": 0.885, "pipeline_1m": 0.89,
                 "ckpt_1m": 0.893, "fleet_1m": 0.895, "build_10m": 0.897,
@@ -2454,6 +2576,14 @@ def main(argv: list[str] | None = None) -> int:
                 "matching": bench_dist_matching(1_000_000, reps=reps),
             }
             flush_detail()
+        if not quick and not skip("hier_1m"):
+            # the multi-host fold (ISSUE 20): 1M matching on the (2,4)
+            # cluster mesh, dense cross-host exchange vs the two-level
+            # ICI/DCN transport — the early-phase dcn-byte reduction is
+            # the acceptance metric (round-1 ≥3x vs flat, the
+            # sparse-transport standard)
+            out["hier_1m"] = bench_hier_1m(1_000_000, reps=reps)
+            flush_detail()
         if not quick and not skip("packed_ab_1m"):
             # packed-native vs unpack/repack at 1M on both engines — the
             # compute-on-words tentpole's wall-clock + graftmem figures
@@ -2611,6 +2741,14 @@ def _compact(out: dict) -> dict:
                     m["ici_bytes_per_round"]["reduction_vs_dense_round1"]
                 )
         compact[key] = row
+    h = out.get("hier_1m")
+    if h and "unsupported" not in h:
+        compact["hier_1m"] = {
+            "dcn_reduction_vs_flat_round1": h["dcn_reduction_vs_flat_round1"],
+            "dcn_reduction_vs_flat_mean": h["dcn_reduction_vs_flat_mean"],
+            "flat_ms_per_round": h["flat"]["ms_per_round"],
+            "hier_ms_per_round": h["hier"]["ms_per_round"],
+        }
     b = out.get("build_10m")
     if b:
         compact["build_10m"] = {

@@ -1,0 +1,272 @@
+"""The 2-D (hosts, devices) cluster mesh: fold bit-identity + the
+two-level ICI/DCN transport (tpu_gossip/cluster/).
+
+The multi-host contract is a FLATTENING invariant: the (H, D) mesh's
+row-major flattening is the flat shard order, collectives run over the
+axis tuple, so a 2-D round is literally the flat program over the same
+shard ids — state AND every integer stat bit-identical, any fold, and
+transitively bit-identical to the local engine where that parity holds
+(the matching pipeline). The hierarchical transport (dense intra-host
+ICI stage + occupancy-compacted cross-host DCN stage) changes only the
+wire representation, never the delivered bits, and must ship fewer DCN
+words than the dense cross-host exchange. The CLI half pins the
+cross-host-count checkpoint leg (save on (2,4), resume on (4,2) and
+flat) and the parse-time rejection surface.
+
+CI runs this file unfiltered in the multihost-smoke job (plus a real
+2-process ``jax.distributed`` launch); the slow-marked folds ride there.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_gossip import SwarmConfig, build_csr, preferential_attachment
+from tpu_gossip.cluster import make_cluster_mesh
+from tpu_gossip.core.state import clone_state, init_swarm
+from tpu_gossip.dist import (
+    build_transport,
+    init_sharded_swarm,
+    partition_graph,
+    shard_matching_plan,
+    shard_swarm,
+    simulate_dist,
+)
+from tpu_gossip.sim.engine import simulate
+
+N_BUCKETED = 250  # not divisible by 8: pad slots ride through the fold
+N_MATCHING = 256
+
+
+def _assert_states_equal(a, b, where=""):
+    for f in dataclasses.fields(type(a)):
+        x, y = getattr(a, f.name), getattr(b, f.name)
+        if f.name == "rng":
+            assert (jax.random.key_data(x) == jax.random.key_data(y)).all()
+        else:
+            assert bool((np.asarray(x) == np.asarray(y)).all()), \
+                f"{where}: {f.name}"
+
+
+def _assert_stats_equal(a, b, where=""):
+    for name, x, y in zip(a._fields, a, b):
+        assert bool((np.asarray(x) == np.asarray(y)).all()), \
+            f"{where}: {name}"
+
+
+# ------------------------------------------------------- bucketed engine
+@pytest.fixture(scope="module")
+def bucketed_setup():
+    g = build_csr(
+        N_BUCKETED, preferential_attachment(N_BUCKETED, m=3, use_native=False)
+    )
+    sg, relabeled, position = partition_graph(g, 8, seed=1)
+    cfg = SwarmConfig(
+        n_peers=sg.n_pad, msg_slots=8, fanout=2, mode="push_pull",
+        churn_leave_prob=0.02, churn_join_prob=0.2,
+    )
+    st = init_sharded_swarm(sg, relabeled, position, cfg, origins=[0])
+    return sg, cfg, st
+
+
+@pytest.fixture(scope="module")
+def bucketed_flat_run(bucketed_setup):
+    """The flat-mesh reference trajectory every fold must reproduce."""
+    sg, cfg, st = bucketed_setup
+    mesh = make_cluster_mesh(hosts=1)
+    fin, stats = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, sg, mesh, 6
+    )
+    return fin, stats
+
+
+@pytest.mark.parametrize(
+    "hosts", [2, pytest.param(4, marks=pytest.mark.slow)]
+)  # the (2,4) fold is the tier-1 witness; (4,2) re-proves the same
+# flattening law through a different row shape on the smoke lane
+def test_bucketed_2d_fold_bit_identical_to_flat(
+    bucketed_setup, bucketed_flat_run, hosts
+):
+    """THE flattening invariant, bucketed engine: the (H, D) fold runs
+    the identical program over the identical shard ids — full state
+    (RNG key included) and every per-round stat, bit for bit."""
+    sg, cfg, st = bucketed_setup
+    fin_f, stats_f = bucketed_flat_run
+    mesh = make_cluster_mesh(hosts=hosts)
+    fin_2, stats_2 = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, sg, mesh, 6
+    )
+    _assert_states_equal(fin_f, fin_2, f"(H={hosts})")
+    _assert_stats_equal(stats_f, stats_2, f"(H={hosts})")
+
+
+def test_bucketed_hier_bit_identical_and_saves_dcn(
+    bucketed_setup, bucketed_flat_run
+):
+    """The two-level transport on (2,4) delivers the dense flat bits
+    exactly, and its compacted DCN stage ships fewer words than the
+    dense cross-host exchange it replaces (the analytic ICI trajectory's
+    per-axis split records both stages)."""
+    sg, cfg, st = bucketed_setup
+    fin_f, stats_f = bucketed_flat_run
+    mesh = make_cluster_mesh(hosts=2)
+    tp = build_transport(sg, mode="hier", hosts=2)
+    fin_h, (stats_h, ici) = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, sg, mesh, 6,
+        transport=tp, collect_ici=True,
+    )
+    _assert_states_equal(fin_f, fin_h, "hier")
+    _assert_stats_equal(stats_f, stats_h, "hier")
+    dcn_dense = int(np.asarray(ici.dcn_dense_words).sum())
+    dcn_ship = int(np.asarray(ici.dcn_shipped_words).sum())
+    assert dcn_dense > 0, "the DCN stage never priced its dense baseline"
+    assert dcn_ship < dcn_dense, (
+        f"two-level transport shipped {dcn_ship} DCN words vs dense "
+        f"{dcn_dense} — the compacted cross-host stage saved nothing"
+    )
+    # the ICI stage is intra-host only: dcn words are a strict subset
+    assert dcn_ship <= int(np.asarray(ici.shipped_words).sum())
+
+
+# ------------------------------------------------------- matching engine
+@pytest.fixture(scope="module")
+def matching_setup():
+    from tpu_gossip.core.matching_topology import (
+        matching_powerlaw_graph_sharded,
+    )
+
+    dg, plan = matching_powerlaw_graph_sharded(
+        N_MATCHING, 8, gamma=2.5, fanout=1, key=jax.random.key(0),
+        export_csr=False,
+    )
+    cfg = SwarmConfig(
+        n_peers=plan.n, msg_slots=16, fanout=1, mode="push_pull",
+    )
+    st = init_swarm(
+        dg.as_padded_graph(), cfg, origins=[0], exists=dg.exists,
+        key=jax.random.key(0),
+    )
+    return plan, cfg, st
+
+
+@pytest.mark.slow  # the hier matching pipeline's compile dominates
+# (~14 s); the fold law keeps its tier-1 witness on the bucketed engine
+# (test_bucketed_2d_fold_bit_identical_to_flat[2]) and the hier lane on
+# test_bucketed_hier_bit_identical_and_saves_dcn — this cell still runs
+# unfiltered in CI's multihost-smoke job and the slow lane
+def test_matching_2d_hier_bit_identical_to_local(matching_setup):
+    """The strongest single witness: the (2,4) fold UNDER the two-level
+    transport is bit-identical to the single-chip engine — which pins
+    fold == flat == local transitively (test_dist.py holds flat ==
+    local), state and stats, and proves the DCN compaction exact."""
+    plan, cfg, st = matching_setup
+    mesh = make_cluster_mesh(hosts=2)
+    splan = shard_matching_plan(plan, mesh)
+    tp = build_transport(plan, mode="hier", hosts=2)
+    fin_l, stats_l = simulate(clone_state(st), cfg, 5, plan)
+    fin_d, (stats_d, ici) = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, splan, mesh, 5,
+        transport=tp, collect_ici=True,
+    )
+    _assert_states_equal(fin_l, fin_d, "matching-hier")
+    _assert_stats_equal(stats_l, stats_d, "matching-hier")
+    assert int(np.asarray(ici.dcn_shipped_words).sum()) < int(
+        np.asarray(ici.dcn_dense_words).sum()
+    )
+
+
+@pytest.mark.slow  # composed cell on the smoke lane; the plain hier
+# witness above keeps the fold law in tier-1
+def test_matching_2d_composed_scenario_stream_control(matching_setup):
+    """One composed scenario x stream x control cell on the (2,4) fold:
+    the optional planes draw at global shape outside shard_map, so the
+    fold must not perturb a single draw — bit-identical to local."""
+    from tpu_gossip.analysis.entrypoints import (
+        _chaos_scenario,
+        _control_plan,
+        _stream_plan,
+    )
+
+    plan, cfg, st = matching_setup
+    mesh = make_cluster_mesh(hosts=2)
+    splan = shard_matching_plan(plan, mesh)
+    kw = dict(
+        scenario=_chaos_scenario(plan.n, N_MATCHING),
+        stream=_stream_plan(16, np.asarray(st.exists)),
+        control=_control_plan(ttl=8),
+    )
+    fin_l, stats_l = simulate(clone_state(st), cfg, 6, plan, **kw)
+    fin_d, stats_d = simulate_dist(
+        shard_swarm(clone_state(st), mesh), cfg, splan, mesh, 6, **kw
+    )
+    _assert_states_equal(fin_l, fin_d, "composed")
+    _assert_stats_equal(stats_l, stats_d, "composed")
+
+
+@pytest.mark.slow  # packed fold leg on the smoke lane; packed parity
+# itself is pinned tier-1 by tests/sim/test_packed.py
+def test_matching_2d_hier_packed_bit_identical(matching_setup):
+    """The packed carry rides the fold + two-level transport unchanged:
+    packed vs unpacked on (2,4) hier, state and stats bit for bit."""
+    from tpu_gossip.core.packed import PackedSwarm, pack_state, unpack_state
+
+    plan, cfg, st = matching_setup
+    mesh = make_cluster_mesh(hosts=2)
+    splan = shard_matching_plan(plan, mesh)
+    tp = build_transport(plan, mode="hier", hosts=2)
+    sharded = shard_swarm(clone_state(st), mesh)
+    fin_u, stats_u = simulate_dist(
+        clone_state(sharded), cfg, splan, mesh, 6, transport=tp
+    )
+    p = pack_state(sharded)
+    assert "peers" in str(p.seen.sharding)
+    fin_p, stats_p = simulate_dist(p, cfg, splan, mesh, 6, transport=tp)
+    assert isinstance(fin_p, PackedSwarm)
+    _assert_states_equal(fin_u, unpack_state(fin_p), "packed-hier")
+    _assert_stats_equal(stats_u, stats_p, "packed-hier")
+
+
+# ------------------------------------------- cross-host checkpoint resume
+@pytest.mark.slow  # four CLI compiles; the multihost-smoke job runs it
+def test_cli_checkpoint_resumes_across_host_counts(tmp_path, capsys):
+    """The resharding contract's cross-host leg, end to end through the
+    CLI: a (2,4) checkpointing run, then the mid-horizon checkpoint
+    resumed onto (4,2) AND onto the flat mesh — every fold finishes
+    with the uninterrupted run's digests."""
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    d = tmp_path / "ck"
+    base = ["--peers", "300", "--graph", "matching", "--fanout", "2",
+            "--shard", "--hosts", "2", "--rounds", "10", "--slots", "4",
+            "--quiet", "--digest"]
+    assert run_sim_main(base + ["--checkpoint-every", "5",
+                                "--checkpoint-dir", str(d)]) == 0
+    ref = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    for hosts in (4, 1):
+        assert run_sim_main(["resume", str(d), "--hosts", str(hosts)]) == 0
+        got = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert got["state_digest"] == ref["state_digest"], f"hosts={hosts}"
+        assert got["stats_digest"] == ref["stats_digest"], f"hosts={hosts}"
+
+
+# --------------------------------------------------- CLI rejection surface
+@pytest.mark.parametrize("argv,needle", [
+    (["--shard", "--hosts", "3"], "does not divide the device count"),
+    (["--hosts", "2"], "add --shard"),
+    (["--transport", "hier"], "two-level"),
+    (["--shard", "--hosts", "2", "--remat-every", "3"],
+     "cannot compose with --remat-every"),
+], ids=["indivisible", "hosts_without_shard", "hier_without_mesh",
+        "hosts_with_remat"])
+def test_cli_cluster_rejections(capsys, argv, needle):
+    """Impossible cluster configs exit 2 at parse time with an error
+    naming the conflict — never a traceback from inside the build."""
+    from tpu_gossip.cli.run_sim import main as run_sim_main
+
+    rc = run_sim_main(["--peers", "64", "--slots", "4", "--quiet"] + argv)
+    assert rc == 2
+    assert needle in capsys.readouterr().err

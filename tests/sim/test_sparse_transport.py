@@ -462,6 +462,7 @@ def test_ici_totals_accumulator_exact_past_int32():
     one = IciRound(
         jnp.int32(30_000_000), jnp.int32(7_654_321), jnp.int32(123_456),
         jnp.int32(5), jnp.int32(6),
+        jnp.int32(25_000_000), jnp.int32(4_321_987),
     )
     tot = zero_ici_totals()
     step = jax.jit(accumulate_ici)
@@ -473,6 +474,8 @@ def test_ici_totals_accumulator_exact_past_int32():
     assert words["occupied_words"] == 12_345_600
     assert words["sparse_lanes"] == 500
     assert words["total_lanes"] == 600
+    assert words["dcn_dense_words"] == 2_500_000_000
+    assert words["dcn_shipped_words"] == 432_198_700
 
 
 def test_auto_mode_is_bit_identical_too(setup):

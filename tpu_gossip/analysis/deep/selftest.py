@@ -10,6 +10,12 @@ and asserts the passes still report them:
   predicate (the local shard's own data). Bit-for-bit the deadlock shape
   ``deep-collective-uniformity`` exists for; jax traces it without
   complaint, which is the point.
+- :func:`divergent_dcn_collective_entry` — the same deadlock shape on
+  the 2-D ``(hosts, peers)`` cluster mesh, with the conditional
+  collective over the slow ``"hosts"`` (DCN) axis. The two-level
+  transport gates its DCN stage on psum'd replicated headers; this
+  fixture is the rotted variant (raw shard-varying predicate) and keeps
+  the rail honest on the axis where a hang is the most expensive.
 - :func:`unpack_spike_entry` — a packed entry whose trace hand-rolls the
   LSB-first shift-and-mask decode OUTSIDE ``core/packed.py``,
   materializing a full-width (N, M) bool plane the budget never priced.
@@ -20,7 +26,7 @@ and asserts the passes still report them:
   SILENT on it — a rail that flags the sanctioned kernels would push
   every packed-native op behind pragmas and rot the gate the other way.
 
-:func:`run_selftest` runs all three and returns the failures (empty =
+:func:`run_selftest` runs all four and returns the failures (empty =
 the rails fire where they must and only there). CI runs it as a step of
 the lint-deep job (``python -m tpu_gossip.analysis --deep-selftest``);
 the same fixtures back tests/analysis/test_collectives.py /
@@ -31,6 +37,7 @@ from __future__ import annotations
 
 __all__ = [
     "divergent_collective_entry",
+    "divergent_dcn_collective_entry",
     "unpack_spike_entry",
     "word_kernel_entry",
     "run_selftest",
@@ -83,6 +90,42 @@ def divergent_collective_entry():
     )
     state = jnp.arange(float(mesh.size * 4)).reshape(mesh.size * 4)
     return _entry("selftest[divergent-collective]", fn, state)
+
+
+def divergent_dcn_collective_entry():
+    """(name, TracedEntry): a DCN-axis collective under a shard-varying
+    branch on the 2-D cluster mesh — the multi-host deadlock variant."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_gossip.cluster.topology import (
+        DEVICE_AXIS,
+        HOST_AXIS,
+        make_cluster_mesh,
+    )
+    from tpu_gossip.dist._compat import shard_map_compat
+
+    mesh = make_cluster_mesh(hosts=2)
+    axes = (HOST_AXIS, DEVICE_AXIS)
+
+    def body(x):
+        # shard-varying predicate (the shard's own slice) guarding a
+        # collective over the slow cross-host axis: some host rows
+        # rendezvous on the DCN psum, the others never post it
+        pred = x[0] > 0.0
+        return jax.lax.cond(
+            pred,
+            lambda v: jax.lax.psum(v, HOST_AXIS),
+            lambda v: v,
+            x,
+        )
+
+    fn = shard_map_compat(
+        body, mesh=mesh, in_specs=P(axes), out_specs=P(axes)
+    )
+    state = jnp.arange(float(mesh.size * 4)).reshape(mesh.size * 4)
+    return _entry("selftest[divergent-dcn-collective]", fn, state)
 
 
 def unpack_spike_entry():
@@ -142,8 +185,8 @@ def word_kernel_entry():
 
 
 def run_selftest() -> list[str]:
-    """Run both adversarial fixtures; returns failure descriptions
-    (empty = both rails fire)."""
+    """Run the adversarial fixtures; returns failure descriptions
+    (empty = the rails fire where they must and only there)."""
     from tpu_gossip.analysis.deep.collectives import RULE as COLL_RULE
     from tpu_gossip.analysis.deep.collectives import entry_program
     from tpu_gossip.analysis.deep.liveness import RULE as LIVE_RULE
@@ -163,6 +206,23 @@ def run_selftest() -> list[str]:
         failures.append(
             f"{name}: {COLL_RULE} did not fire on a collective under a "
             "shard-varying branch arm"
+        )
+
+    name, te = divergent_dcn_collective_entry()
+    ops, findings = entry_program(name, te)
+    from tpu_gossip.dist.mesh import axis_kind
+    if not any(
+        axis_kind(ax) == "dcn" for op in ops for ax in op.axes
+    ):
+        failures.append(
+            f"{name}: the conditional host-axis psum was not recorded "
+            "as a dcn-class collective"
+        )
+    if not any(f.rule == COLL_RULE and "diverges" in f.message
+               for f in findings):
+        failures.append(
+            f"{name}: {COLL_RULE} did not fire on a DCN-axis collective "
+            "under a shard-varying branch arm"
         )
 
     name, te = unpack_spike_entry()

@@ -312,9 +312,18 @@ def _dist_ctx():
         )
         return st, cfg
 
+    # the 2-D (hosts, devices) cluster fold of the SAME device order —
+    # row-major, so every 2-D entry is the flat entry's program
+    # (cluster/topology.py); None when the host mesh has no even fold
+    from tpu_gossip.cluster import make_cluster_mesh
+
+    mesh2 = (
+        make_cluster_mesh(hosts=2)
+        if mesh.size >= 2 and mesh.size % 2 == 0 else None
+    )
     return {
-        "mesh": mesh, "g": g, "plan": plan, "sg": sg, "m_state": m_state,
-        "b_state": b_state,
+        "mesh": mesh, "mesh2": mesh2, "g": g, "plan": plan, "sg": sg,
+        "m_state": m_state, "b_state": b_state,
     }
 
 
@@ -750,9 +759,11 @@ def _dist_entries() -> list[EntryPoint]:
     eps: list[EntryPoint] = []
 
     def dist_ep(name, eng, audit_check, state_kw, round_kw, *,
-                kind="round", stats_leading=(), has_ici=False, jit_name=None):
+                kind="round", stats_leading=(), has_ici=False, jit_name=None,
+                mesh2=False):
         mk_state = dctx["m_state"] if eng == "dist-matching" else dctx["b_state"]
         graph_plan = plan if eng == "dist-matching" else sg
+        mesh = dctx["mesh2"] if mesh2 else dctx["mesh"]
 
         def build():
             st, cfg = mk_state(**state_kw)
@@ -775,6 +786,13 @@ def _dist_entries() -> list[EntryPoint]:
                 from tpu_gossip.dist import transport as tp
 
                 kw["transport"] = tp.build_transport(graph_plan, mode="sparse")
+            if kw.pop("hier", False):
+                from tpu_gossip.cluster.topology import mesh_hosts
+                from tpu_gossip.dist import transport as tp
+
+                kw["transport"] = tp.build_transport(
+                    graph_plan, mode="hier", hosts=mesh_hosts(mesh)[0]
+                )
             if kw.pop("stream", False):
                 kw["stream"] = _stream_plan(16, st.exists)
             if kw.pop("ingest", False):
@@ -982,6 +1000,31 @@ def _dist_entries() -> list[EntryPoint]:
         "dist[bucketed,sparse]", "dist-bucketed", "sparse_transport",
         {}, dict(sparse=True, collect_ici=True), has_ici=True,
     ))
+    # the 2-D (hosts, devices) cluster mesh (cluster/topology.py): the
+    # dense rounds over the axis TUPLE are the flat rounds' programs —
+    # same fixed point, same wire declaration (the wire audit compares
+    # them against the SAME dense_wire_words) — and the hier entries run
+    # the two-level ICI/DCN transport (cluster/hier.py) with its
+    # host-axis collectives under the shard-uniformity rail
+    if dctx["mesh2"] is not None:
+        eps.append(dist_ep(
+            "dist[matching,2d]", "dist-matching", "gossip_round_dist",
+            {}, {}, mesh2=True,
+        ))
+        eps.append(dist_ep(
+            "dist[bucketed,2d]", "dist-bucketed", "gossip_round_dist",
+            {}, {}, mesh2=True,
+        ))
+        eps.append(dist_ep(
+            "dist[matching,hier]", "dist-matching", "sparse_transport",
+            {}, dict(hier=True, collect_ici=True), has_ici=True,
+            mesh2=True,
+        ))
+        eps.append(dist_ep(
+            "dist[bucketed,hier]", "dist-bucketed", "sparse_transport",
+            {}, dict(hier=True, collect_ici=True), has_ici=True,
+            mesh2=True,
+        ))
     return eps
 
 

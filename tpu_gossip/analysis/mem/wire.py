@@ -29,10 +29,15 @@ __all__ = ["wire_findings", "collective_census"]
 WIRE_RULE = "mem-wire-drift"
 
 # dense entries audited: name -> engine family (mode/slots fixed by the
-# matrix: push_pull, msg_slots=16, forward_once False)
+# matrix: push_pull, msg_slots=16, forward_once False). The 2-D cluster
+# entries compare against the SAME declarations — the (hosts, devices)
+# fold is the flat program, so its dense wire is the flat wire; their
+# census additionally carries the per_axis ici/dcn byte split
 _WIRE_ENTRIES = {
     "dist[bucketed]": "bucketed",
     "dist[matching]": "matching",
+    "dist[bucketed,2d]": "bucketed",
+    "dist[matching,2d]": "matching",
 }
 
 # psum2/pmax2/pmin2 are the check_rep-era spellings jax traces for the

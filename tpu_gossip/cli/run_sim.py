@@ -93,7 +93,8 @@ def build_parser() -> argparse.ArgumentParser:
         "the per-shard staircase kernel (the north-star fusion)",
     )
     p.add_argument(
-        "--transport", choices=["dense", "sparse", "auto"], default="dense",
+        "--transport", choices=["dense", "sparse", "auto", "hier"],
+        default="dense",
         help="sharded-exchange transport (dist/transport.py, docs/"
         "sparse_exchange.md): dense ships the full rectangular all_to_all "
         "payloads every round; sparse compacts occupied words into a "
@@ -101,9 +102,43 @@ def build_parser() -> argparse.ArgumentParser:
         "(hub rows ride a dense sub-lane on the matching family), falling "
         "back to the dense lane whenever the round's occupancy exceeds "
         "the budget; auto additionally requires the static geometry to "
-        "predict a byte win. Bit-identical to dense in every mode — the "
-        "transport reorders bytes, never draws. Requires --shard; the "
-        "summary JSON gains transport + realized occupancy/bytes fields",
+        "predict a byte win; hier is the TWO-LEVEL ICI/DCN transport "
+        "(cluster/hier.py, docs/multihost_mesh.md) — dense inside each "
+        "fast intra-host slice, compacted across the slow host axis — "
+        "and needs --hosts H > 1. Bit-identical to dense in every mode — "
+        "the transport reorders bytes, never draws. Requires --shard; "
+        "the summary JSON gains transport + realized occupancy/bytes "
+        "fields (per-axis ici_bytes/dcn_bytes under --hosts)",
+    )
+    p.add_argument(
+        "--hosts", type=int, default=1, metavar="H",
+        help="fold the device mesh into a 2-D (hosts, devices) cluster "
+        "mesh (cluster/topology.py, docs/multihost_mesh.md): collectives "
+        "run over the axis tuple, which flattens row-major to the same "
+        "shard order, so the trajectory is BIT-IDENTICAL to the flat "
+        "1-D mesh — state and every integer stat. H must divide the "
+        "device count. Requires --shard; enables --transport hier and "
+        "splits the summary's wire accounting into per-axis ici/dcn "
+        "bytes. 1 = flat mesh (the default)",
+    )
+    p.add_argument(
+        "--coordinator", type=str, default="", metavar="ADDR",
+        help="run as ONE process of a real multi-host jax.distributed "
+        "cluster (cluster/launch.py): ADDR is the coordinator's "
+        "host:port; needs --num-processes and --process-id, and --hosts "
+        "must equal --num-processes (one process per mesh host row). "
+        "Single-machine multi-process launches go through "
+        "`python -m tpu_gossip.cluster.launch`",
+    )
+    p.add_argument(
+        "--num-processes", type=int, default=0, metavar="P",
+        help="total process count of the jax.distributed cluster "
+        "(with --coordinator)",
+    )
+    p.add_argument(
+        "--process-id", type=int, default=-1, metavar="I",
+        help="this process's rank in [0, --num-processes) "
+        "(with --coordinator)",
     )
     p.add_argument(
         "--tail", choices=["fused", "reference", "pallas"], default="fused",
@@ -380,6 +415,24 @@ def _run(args, resume=None) -> int:
     from tpu_gossip.core.state import SwarmConfig, init_swarm, save_swarm
     from tpu_gossip.sim import metrics as M
     from tpu_gossip.sim.engine import simulate
+
+    cluster_err = _validate_cluster(args)
+    if cluster_err:
+        print(cluster_err, file=sys.stderr)
+        return 2
+    if args.coordinator:
+        # join the jax.distributed cluster BEFORE anything touches the
+        # backend — the first jax.devices() call settles it
+        from tpu_gossip.cluster.launch import init_distributed
+
+        init_distributed(args.coordinator, args.num_processes,
+                         args.process_id)
+    if args.hosts > 1 and len(jax.devices()) % args.hosts:
+        print(f"--hosts {args.hosts} does not divide the device count "
+              f"{len(jax.devices())} (the cluster mesh folds the flat "
+              "device order row-major into (hosts, devices))",
+              file=sys.stderr)
+        return 2
 
     rng = np.random.default_rng(args.seed)
     spec = None
@@ -678,10 +731,10 @@ def _run(args, resume=None) -> int:
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     summary.update(_layout_summary(args))
-    print(json.dumps(summary))
-
-    if args.checkpoint:
-        save_swarm(args.checkpoint, fin)
+    if jax.process_index() == 0:
+        print(json.dumps(summary))
+        if args.checkpoint:
+            save_swarm(args.checkpoint, fin)
     return 0
 
 
@@ -941,6 +994,13 @@ def _main_resume(argv: list[str]) -> int:
         "a mesh — bit-identical to finishing on the mesh (the s=1 "
         "layout-truth contract in reverse)",
     )
+    p.add_argument("--hosts", type=int, default=-1, metavar="H",
+                   help="override the recorded --hosts: resume onto a "
+                   "different (hosts, devices) fold of the SAME device "
+                   "count, or 1 for the flat mesh. The fold is row-major "
+                   "— layout and trajectory stay bit-identical across "
+                   "host counts (docs/multihost_mesh.md), the "
+                   "resharding contract's cross-host leg")
     p.add_argument("--lane", type=int, default=-1, metavar="K",
                    help="fleet checkpoints: resume lane K solo (with "
                    "--solo) instead of the whole stack")
@@ -991,6 +1051,18 @@ def _main_resume(argv: list[str]) -> int:
         print(f"resume: manifest records unknown args {stale} (ignored "
               "beyond layout checks)", file=sys.stderr)
     args.quiet = bool(rargs.quiet or args.quiet)
+    if rargs.hosts >= 1:
+        if not run_cfg.get("shard"):
+            print("resume: --hosts re-folds a SHARDED checkpoint's mesh; "
+                  "this run was local", file=sys.stderr)
+            return 2
+        args.hosts = rargs.hosts
+        if args.hosts == 1 and args.transport == "hier":
+            print("resume: the recorded --transport hier needs a host "
+                  "axis; continuing on the flat mesh with --transport "
+                  "sparse (trajectory unchanged — the transport reorders "
+                  "bytes, never draws)", file=sys.stderr)
+            args.transport = "sparse"
     if rargs.local:
         if not (run_cfg.get("shard") and run_cfg.get("graph") == "matching"
                 and not run_cfg.get("remat_every")):
@@ -1347,6 +1419,52 @@ def _liveness_summary(args, stats=None) -> dict:
     return out
 
 
+def _validate_cluster(args):
+    """Reject impossible --hosts/--coordinator configs; returns an error
+    string (exit 2) or None — the multi-host twin of
+    :func:`_validate_ckpt`. (The device-count divisibility check lives
+    at the call site: it needs the backend, which must not be touched
+    before ``jax.distributed`` initializes.)"""
+    if args.hosts < 1:
+        return f"--hosts {args.hosts} must be >= 1"
+    if args.hosts > 1 and not args.shard:
+        return ("--hosts folds the SHARDED device mesh into a 2-D "
+                "(hosts, devices) cluster mesh; add --shard (the local "
+                "engine has no mesh to fold)")
+    if args.hosts > 1 and args.remat_every > 0:
+        return ("--hosts cannot compose with --remat-every: the epoch "
+                "re-partition rebuilds bucket tables for the flat shard "
+                "order only — run the remat loop on the flat mesh")
+    if args.transport == "hier" and args.hosts <= 1:
+        return ("--transport hier is the two-level ICI/DCN transport "
+                "(dense inside each host slice, compacted across the "
+                "host axis); it needs a (hosts, devices) mesh — add "
+                "--hosts H > 1")
+    if args.coordinator:
+        if args.num_processes < 2 or \
+                not (0 <= args.process_id < args.num_processes):
+            return ("--coordinator needs --num-processes P >= 2 and "
+                    "--process-id in [0, P) — one rank per process "
+                    "(cluster/launch.py spawns them)")
+        if args.hosts != args.num_processes:
+            return (f"--hosts {args.hosts} must equal --num-processes "
+                    f"{args.num_processes}: the mesh's host axis is one "
+                    "row per process")
+        if args.rounds <= 0:
+            return ("multi-process runs need a fixed --rounds horizon "
+                    "(the coverage loop fetches per-process)")
+        if args.checkpoint_every > 0 or args.checkpoint:
+            return ("checkpointing is single-process for now: the ckpt "
+                    "store writes addressable shard files; exercise the "
+                    "cross-host restart contract through single-process "
+                    "2-D runs (tests/sim/test_cluster.py)")
+        if args.profile:
+            return "--profile records a single process's trace; drop it"
+    elif args.num_processes or args.process_id >= 0:
+        return "--num-processes/--process-id need --coordinator"
+    return None
+
+
 def _validate_ckpt(args):
     """Normalize + reject impossible checkpointing configs; returns an
     error string (exit 2) or None — the durability twin of
@@ -1453,6 +1571,34 @@ def _split_host_stats(sd: dict):
 
         ici = IciRound(*(sd[f"ici__{f}"] for f in IciRound._fields))
     return stats, ici
+
+
+def _gather_global(tree):
+    """Multi-process runs: pull every non-addressable (cross-host
+    sharded) array leaf back as its full global value so the summary's
+    host-side accounting — digests, coverage, save_swarm — reads the
+    whole swarm on every process. Single-process: identity."""
+    import jax
+
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    def g(x):
+        if not (isinstance(x, jax.Array) and not x.is_fully_addressable):
+            return x
+        if jax.numpy.issubdtype(x.dtype, jax.dtypes.prng_key):
+            # key arrays can't cross numpy; gather the raw key data and
+            # re-wrap
+            data = multihost_utils.process_allgather(
+                jax.random.key_data(x), tiled=True
+            )
+            return jax.random.wrap_key_data(
+                jax.numpy.asarray(data), impl=jax.random.key_impl(x)
+            )
+        return multihost_utils.process_allgather(x, tiled=True)
+
+    return jax.tree_util.tree_map(g, tree)
 
 
 def _swap_in_resume(resume, state, args):
@@ -1716,6 +1862,24 @@ def _transport_summary(args, ici=None, rounds=0, graph=None) -> dict:
             tot["dense_words"] / max(tot["shipped_words"], 1), 3
         ),
     }
+    if getattr(args, "hosts", 1) > 1:
+        # the per-axis split of the same totals (IciRound's dcn_* columns
+        # price the slow host axis; ici = total - dcn is the fast
+        # intra-host remainder) — ici_bytes_per_round above stays the
+        # TOTAL wire, keys unchanged
+        dcn_d, dcn_s = tot["dcn_dense_words"], tot["dcn_shipped_words"]
+        ici_d = tot["dense_words"] - dcn_d
+        ici_s = tot["shipped_words"] - dcn_s
+        out["ici_bytes"] = {
+            "dense": round(4 * ici_d / r, 1),
+            "shipped": round(4 * ici_s / r, 1),
+            "reduction_vs_dense": round(ici_d / max(ici_s, 1), 3),
+        }
+        out["dcn_bytes"] = {
+            "dense": round(4 * dcn_d / r, 1),
+            "shipped": round(4 * dcn_s / r, 1),
+            "reduction_vs_dense": round(dcn_d / max(dcn_s, 1), 3),
+        }
     if graph is not None:
         from tpu_gossip.core.matching_topology import MatchingPlan
 
@@ -2255,7 +2419,12 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                   "(trajectory unchanged — the transport reorders bytes, "
                   "never draws)", file=sys.stderr)
     else:
-        mesh = make_mesh()
+        if args.hosts > 1:
+            from tpu_gossip.cluster import make_cluster_mesh
+
+            mesh = make_cluster_mesh(hosts=args.hosts)
+        else:
+            mesh = make_mesh()
         if 128 % mesh.size:
             # the transpose all_to_all splits the 128-lane axis; a mesh
             # size that does not divide 128 cannot run the sharded
@@ -2298,7 +2467,8 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
     from tpu_gossip.dist import build_transport
 
     transport = (
-        build_transport(plan, mode=args.transport, mesh=mesh)
+        build_transport(plan, mode=args.transport, mesh=mesh,
+                        hosts=args.hosts)
         if args.transport != "dense" and not local else None
     )
     cfg = SwarmConfig(
@@ -2329,10 +2499,11 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
     if not local:
         state = shard_swarm(state, mesh)
 
+    from tpu_gossip.core.state import shard_ranges
+
     scen = _compile_cli_scenario(
         spec, args, n_slots=plan.n, node_map=to_rows,
-        shard_ranges=[(s * plan.n_blk, (s + 1) * plan.n_blk)
-                      for s in range(n_build)],
+        shard_ranges=shard_ranges(n_build, plan.n_blk, mesh=mesh),
         n_shards=n_build,
     )
     grow = _compile_cli_growth(args, spec, n_slots=plan.n, mplan=plan)
@@ -2405,7 +2576,8 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                 if args.packed:
                     fin = unpack_state(fin)
                 stats, ici = _split_host_stats(sd)
-            if not args.quiet:
+            fin = _gather_global(fin)
+            if not args.quiet and jax.process_index() == 0:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(
                 args, stats, devices=n_build,
@@ -2457,10 +2629,10 @@ def _main_shard_matching(args, rng, spec=None, resume=None,
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     summary.update(_layout_summary(args))
-    print(json.dumps(summary))
-
-    if args.checkpoint:
-        save_swarm(args.checkpoint, fin)
+    if jax.process_index() == 0:
+        print(json.dumps(summary))
+        if args.checkpoint:
+            save_swarm(args.checkpoint, fin)
     return 0
 
 
@@ -2483,7 +2655,12 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     from tpu_gossip.sim import metrics as M
     from tpu_gossip.utils.profiling import trace
 
-    mesh = make_mesh()
+    if args.hosts > 1:
+        from tpu_gossip.cluster import make_cluster_mesh
+
+        mesh = make_cluster_mesh(hosts=args.hosts)
+    else:
+        mesh = make_mesh()
     gexists = None
     if args.grow:
         from tpu_gossip.growth import pad_graph_for_growth
@@ -2491,7 +2668,7 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
         graph, gexists = pad_graph_for_growth(graph, args.grow_capacity)
     sg, relabeled, position = partition_graph(graph, mesh.size, seed=args.seed)
     transport = (
-        build_transport(sg, mode=args.transport)
+        build_transport(sg, mode=args.transport, hosts=args.hosts)
         if args.transport != "dense" else None
     )
     cfg = SwarmConfig(
@@ -2515,12 +2692,21 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
     if silent_ids is not None:
         state.silent = state.silent.at[position[silent_ids]].set(True)
     state = shard_swarm(state, mesh)
+    if jax.process_count() > 1:
+        # shard_map operands must be GLOBAL arrays when the mesh spans
+        # processes; single-process runs keep the host arrays (jit
+        # places them). Placed LAST: build_transport/init consume the
+        # host copies above
+        from tpu_gossip.dist import shard_graph
+
+        sg = shard_graph(sg, mesh)
+
+    from tpu_gossip.core.state import shard_ranges
 
     scen = _compile_cli_scenario(
         spec, args, n_slots=sg.n_pad,
         node_map=lambda ids: position[np.asarray(ids)],
-        shard_ranges=[(s * sg.per_shard, (s + 1) * sg.per_shard)
-                      for s in range(mesh.size)],
+        shard_ranges=shard_ranges(mesh.size, sg.per_shard, mesh=mesh),
         n_shards=mesh.size,
     )
     grow = _compile_cli_growth(
@@ -2593,7 +2779,8 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                 if args.packed:
                     fin = unpack_state(fin)
                 stats, ici = _split_host_stats(sd)
-            if not args.quiet:
+            fin = _gather_global(fin)
+            if not args.quiet and jax.process_index() == 0:
                 M.write_jsonl(stats, sys.stdout)
             summary = _horizon_summary(
                 args, stats, devices=mesh.size,
@@ -2646,10 +2833,10 @@ def _main_shard(args, graph, rng, spec=None, resume=None) -> int:
                        **json.loads(result.to_json())}
     summary.update(_growth_summary(args, fin))
     summary.update(_layout_summary(args))
-    print(json.dumps(summary))
-
-    if args.checkpoint:
-        save_swarm(args.checkpoint, fin)
+    if jax.process_index() == 0:
+        print(json.dumps(summary))
+        if args.checkpoint:
+            save_swarm(args.checkpoint, fin)
     return 0
 
 

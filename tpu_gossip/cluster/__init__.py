@@ -1,0 +1,31 @@
+"""Multi-host mesh runtime: (hosts, devices) axes, hierarchical transport,
+and the ``jax.distributed`` process launcher.
+
+- :mod:`tpu_gossip.cluster.topology` — the axis model (``make_cluster_mesh``,
+  ``mesh_axes``, ``mesh_hosts``) and multi-process-safe placement;
+- :mod:`tpu_gossip.cluster.hier` — the two-level ICI/DCN collective
+  decompositions the ``--transport hier`` mode runs;
+- :mod:`tpu_gossip.cluster.launch` — gloo-backed ``jax.distributed``
+  initialization and the localhost multi-process launcher.
+
+See docs/multihost_mesh.md for the axis semantics and the determinism
+contract.
+"""
+
+from tpu_gossip.cluster.topology import (
+    DEVICE_AXIS,
+    HOST_AXIS,
+    global_put,
+    make_cluster_mesh,
+    mesh_axes,
+    mesh_hosts,
+)
+
+__all__ = [
+    "HOST_AXIS",
+    "DEVICE_AXIS",
+    "make_cluster_mesh",
+    "mesh_axes",
+    "mesh_hosts",
+    "global_put",
+]

@@ -1,0 +1,237 @@
+"""Hierarchical ICI/DCN two-level transport: the host axis ships sparse.
+
+On a (hosts, devices) mesh the flat combined-axis ``all_to_all`` crosses
+the slow DCN wire with its FULL operand every round. This module
+decomposes each dist-engine collective into two stages — a dense
+intra-host stage over the fast ``"peers"`` (ICI) axis and a compacted
+cross-host stage over the slow ``"hosts"`` (DCN) axis — which is the
+power-law-aware staged reduction of *Sparse Allreduce* (PAPERS.md)
+applied to the gossip exchanges: the intra-host stage concentrates each
+host's traffic, and only the occupied entries cross hosts, with an index
+plane, behind the same replicated-occupancy ``lax.cond`` gate the flat
+sparse transport uses (dist/transport.py).
+
+Determinism contract, inherited verbatim: every stage is an EXACT
+decomposition of the flat collective (unoccupied entries are zero by
+construction, so the receiver-side scatter reconstructs the dense result
+bit for bit), and no stage draws — hierarchical rounds are bit-identical
+to flat rounds on both engines, composed scenario/stream/control/packed
+cells included (tests/sim/test_cluster.py pins the matrix).
+
+Stage algebra (validated against the flat collectives on (2,4) and (4,2)
+reshapes of the 8-device mesh):
+
+- bucketed exchange ``all_to_all(split=0, concat=0)`` over the tuple axis
+  ==  moveaxis + device-axis a2a + moveaxis + host-axis a2a
+  (:func:`bucketed_hier_exchange`);
+- matching transpose ``all_to_all(split=1, concat=0)`` over the tuple
+  ==  host-axis a2a(split=1) FIRST, then device-axis a2a(split=1), then
+  one local row-block reorder (:func:`transpose_pass_hier`) — the
+  hosts-first order is load-bearing: device-first delivers the wrong
+  column slice;
+- the inverse composes the inverse stages in reverse
+  (:func:`untranspose_pass_hier`).
+
+The DCN stage of each primitive row-compacts on occupancy exactly like
+``transpose_pass_sparse``: nonzero byte count is conserved by the
+permutation stages, occupied rows never exceed nonzero bytes, so ONE
+``psum`` over both axes per pipeline application bounds every stage's
+host-axis occupancy — the flat sparse transport's conservation trick,
+one level up.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_gossip.cluster.topology import DEVICE_AXIS, HOST_AXIS
+from tpu_gossip.dist.transport import (
+    compact_index,
+    gather_compact,
+    scatter_compact,
+)
+
+__all__ = [
+    "bucketed_hier_exchange",
+    "transpose_pass_hier",
+    "untranspose_pass_hier",
+    "apply_pipeline_hier",
+]
+
+
+def bucketed_hier_exchange(
+    payload: jax.Array,
+    hosts: int,
+    cap: int,
+    fits: jax.Array,
+    *,
+    host_axis: str = HOST_AXIS,
+    dev_axis: str = DEVICE_AXIS,
+) -> jax.Array:
+    """Two-stage twin of the bucketed engine's dense ``all_to_all``.
+
+    ``payload`` is one shard's (S, B, W) destination-major bucket block.
+    Stage 1 (ICI, dense): route every ``(dst_h, dst_d)`` bucket to local
+    device ``dst_d`` over the fast axis. Stage 2 (DCN): each device now
+    holds, per destination host, the ``D·B`` entries of its own host's
+    traffic for that host's device ``d_me`` — occupied entries compact to
+    the static ``cap`` budget with an index plane, or ride dense when the
+    caller's replicated ``fits`` gate (pre-activation occupancy, pmax'd
+    over BOTH axes) says the budget would overflow. The receiver scatters
+    into the exact dense buffer, so the result equals the flat collective
+    bit for bit.
+    """
+    s, b, w = payload.shape
+    h = hosts
+    d = s // h
+    y = jnp.moveaxis(payload.reshape(h, d, b, w), 1, 0)  # [dst_d, dst_h, ...]
+    y = jax.lax.all_to_all(
+        y, dev_axis, split_axis=0, concat_axis=0, tiled=True
+    )  # [src_d, dst_h, B, W] on device dst_d (src_h = my host)
+    z = jnp.moveaxis(y, 1, 0).reshape(h, d * b, w)  # [dst_h, src_d·B, W]
+
+    def compact_lane():
+        occ = (z != 0).any(-1)  # (H, D·B)
+        idx = compact_index(occ, cap)  # (H, C), sentinel D·B
+        cvals = gather_compact(z, idx)  # (H, C, W)
+        idx_r = jax.lax.all_to_all(
+            idx, host_axis, split_axis=0, concat_axis=0, tiled=True
+        )
+        cvals_r = jax.lax.all_to_all(
+            cvals, host_axis, split_axis=0, concat_axis=0, tiled=True
+        )
+        return scatter_compact(idx_r, cvals_r, d * b)
+
+    def dense_lane():
+        return jax.lax.all_to_all(
+            z, host_axis, split_axis=0, concat_axis=0, tiled=True
+        )
+
+    zr = jax.lax.cond(fits, compact_lane, dense_lane)  # [src_h, src_d·B, W]
+    return zr.reshape(s, b, w)
+
+
+def transpose_pass_hier(
+    x_blk: jax.Array,
+    hosts: int,
+    n_shards: int,
+    cap: int,
+    take: jax.Array,
+    *,
+    host_axis: str = HOST_AXIS,
+    dev_axis: str = DEVICE_AXIS,
+) -> jax.Array:
+    """Two-stage twin of ``permute.transpose_pass_sharded``.
+
+    DCN stage FIRST (hosts-first is required for the column slices to
+    land): my block's occupied rows compact to ``cap`` and cross the host
+    axis split column-wise with an ``all_gather``'d index plane (dense
+    when the replicated ``take`` gate says the budget would overflow) —
+    then the dense ICI stage splits the remaining columns over the fast
+    axis, and one local row-block reorder restores the flat source-major
+    order before the shared transpose-reshape.
+    """
+    per = x_blk.shape[0]
+    h, s = hosts, n_shards
+    d = s // h
+    c = 128 // s
+
+    def stage_a_sparse():
+        occ = (x_blk != 0).any(axis=1)  # (per,)
+        idx = compact_index(occ[None, :], cap)[0]  # (C,), sentinel per
+        cvals = gather_compact(x_blk[None], idx[None])[0]  # (C, 128)
+        cv_r = jax.lax.all_to_all(
+            cvals, host_axis, split_axis=1, concat_axis=0, tiled=True
+        ).reshape(h, cap, 128 // h)
+        idx_g = jax.lax.all_gather(idx, host_axis)  # (H, C)
+        return scatter_compact(idx_g, cv_r, per).reshape(h * per, 128 // h)
+
+    def stage_a_dense():
+        return jax.lax.all_to_all(
+            x_blk, host_axis, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    sa = jax.lax.cond(take, stage_a_sparse, stage_a_dense)  # (H·per, 128/H)
+    sb = jax.lax.all_to_all(
+        sa, dev_axis, split_axis=1, concat_axis=0, tiled=True
+    )  # (S·per, 128/S), rows [src_d][src_h][per]
+    out = sb.reshape(d, h, per, c).swapaxes(0, 1).reshape(s * per, c)
+    return out.T.reshape(per, 128)
+
+
+def untranspose_pass_hier(
+    x_blk: jax.Array,
+    hosts: int,
+    n_shards: int,
+    cap: int,
+    take: jax.Array,
+    *,
+    host_axis: str = HOST_AXIS,
+    dev_axis: str = DEVICE_AXIS,
+) -> jax.Array:
+    """Two-stage twin of ``permute.untranspose_pass_sharded`` — the
+    inverse stages of :func:`transpose_pass_hier` in reverse order, so
+    the DCN stage comes LAST and compacts per destination-host row block
+    with a per-block index plane."""
+    per = x_blk.shape[0]
+    h, s = hosts, n_shards
+    d = s // h
+    r = per * s
+    c = 128 // s
+    slab = x_blk.reshape(c, r).T  # (S·per, c), rows [src_h][src_d][per]
+    yb = slab.reshape(h, d, per, c).swapaxes(0, 1).reshape(d * h * per, c)
+    y1 = jax.lax.all_to_all(
+        yb, dev_axis, split_axis=0, concat_axis=1, tiled=True
+    )  # (H·per, 128/H)
+    y1r = y1.reshape(h, per, 128 // h)
+
+    def stage_b_sparse():
+        occ = (y1r != 0).any(-1)  # (H, per)
+        idx = compact_index(occ, cap)  # (H, C)
+        cvals = gather_compact(y1r, idx)  # (H, C, 128/H)
+        idx_r = jax.lax.all_to_all(
+            idx, host_axis, split_axis=0, concat_axis=0, tiled=True
+        )
+        cvals_r = jax.lax.all_to_all(
+            cvals, host_axis, split_axis=0, concat_axis=0, tiled=True
+        )
+        return scatter_compact(idx_r, cvals_r, per)  # (H, per, 128/H)
+
+    def stage_b_dense():
+        return jax.lax.all_to_all(
+            y1, host_axis, split_axis=0, concat_axis=1, tiled=True
+        ).reshape(per, h, 128 // h).swapaxes(0, 1)
+
+    out = jax.lax.cond(take, stage_b_sparse, stage_b_dense)
+    return jnp.moveaxis(out, 0, 1).reshape(per, 128)
+
+
+def apply_pipeline_hier(
+    x: jax.Array,
+    stages: tuple,
+    hosts: int,
+    n_shards: int,
+    cap: int,
+    take: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``permute.apply_pipeline`` with every transpose stage run
+    two-level: lane shuffles stay row-local and shared; each "t"/"tinv"
+    becomes its hierarchical twin, whose DCN stage lane-gates on the ONE
+    replicated ``take`` computed per pipeline application (nonzero bytes
+    are conserved by the stages, so one count bounds them all)."""
+    from tpu_gossip.kernels.permute import lane_shuffle
+
+    for stage in stages:
+        kind = stage[0]
+        if kind == "lane":
+            x = lane_shuffle(x, stage[1], interpret=interpret)
+        elif kind == "t":
+            x = transpose_pass_hier(x, hosts, n_shards, cap, take)
+        elif kind == "tinv":
+            x = untranspose_pass_hier(x, hosts, n_shards, cap, take)
+        else:  # pragma: no cover - plan construction bug
+            raise ValueError(f"unknown stage kind {kind!r}")
+    return x

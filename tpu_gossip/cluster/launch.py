@@ -1,0 +1,134 @@
+"""Multi-process launcher: real ``jax.distributed`` workers on one machine.
+
+Two halves:
+
+- :func:`init_distributed` — the in-process half ``run_sim`` calls when
+  its coordinator flags are set: selects the gloo CPU collectives
+  implementation (the config knob must be set BEFORE
+  ``jax.distributed.initialize``; the default CPU backend refuses
+  multi-process collectives outright) and joins the coordination service.
+  After it returns, ``jax.devices()`` spans every process and
+  ``make_cluster_mesh(hosts=num_processes)`` builds the real 2-D mesh
+  whose host rows are the per-process local devices.
+
+- the ``__main__`` launcher — spawns N copies of ``run_sim`` (or any
+  argv) on localhost, one process per host row, each pinned to
+  ``devices_per_host`` emulated CPU devices, with the coordinator flags
+  appended per process. Exit code is the workers' maximum, and each
+  worker's output is prefixed with its process id. This is the
+  single-machine stand-in for a real cluster scheduler: the CI
+  ``multihost-smoke`` job drives it and asserts the 2-process digest
+  equals the single-process one.
+
+Usage::
+
+    python -m tpu_gossip.cluster.launch --nprocs 2 --devices-per-host 4 \\
+        -- --shard --graph matching -n 997 --rounds 6 --digest
+
+The separator ``--`` splits launcher flags from the ``run_sim`` argv.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["init_distributed", "launch_workers", "main"]
+
+
+def init_distributed(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """Join a ``jax.distributed`` cluster as one worker process.
+
+    Must run before any other jax API touches the backend. On CPU the
+    gloo collectives implementation is selected first — the env-var
+    spelling of this knob is NOT honored by the versions the container
+    straddles, only the config update is.
+    """
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def launch_workers(
+    worker_argv: list[str],
+    nprocs: int,
+    devices_per_host: int,
+    *,
+    port: int = 12723,
+    timeout: float | None = None,
+) -> int:
+    """Spawn ``nprocs`` run_sim workers on localhost; return max exit code.
+
+    Each worker runs ``python -m tpu_gossip.cli.run_sim <worker_argv>
+    --hosts N --coordinator 127.0.0.1:port --num-processes N
+    --process-id i`` with ``devices_per_host`` emulated CPU devices.
+    Output streams through with a ``[i]`` prefix so interleaved worker
+    logs stay attributable.
+    """
+    procs = []
+    for i in range(nprocs):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_host}"
+        )
+        argv = [
+            sys.executable, "-m", "tpu_gossip.cli.run_sim", *worker_argv,
+            "--hosts", str(nprocs),
+            "--coordinator", f"127.0.0.1:{port}",
+            "--num-processes", str(nprocs),
+            "--process-id", str(i),
+        ]
+        procs.append((i, subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )))
+    rc = 0
+    for i, p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            print(f"[{i}] TIMED OUT", flush=True)
+            rc = max(rc, 124)
+        for line in (out or "").splitlines():
+            print(f"[{i}] {line}", flush=True)
+        rc = max(rc, p.returncode or 0)
+    return rc
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_gossip.cluster.launch",
+        description="spawn N jax.distributed run_sim workers on localhost",
+    )
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--devices-per-host", type=int, default=4)
+    ap.add_argument("--port", type=int, default=12723)
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("worker_argv", nargs=argparse.REMAINDER,
+                    help="run_sim argv after a -- separator")
+    args = ap.parse_args(argv)
+    worker = args.worker_argv
+    if worker and worker[0] == "--":
+        worker = worker[1:]
+    if not worker:
+        ap.error("no run_sim argv given (append it after --)")
+    return launch_workers(
+        worker, args.nprocs, args.devices_per_host,
+        port=args.port, timeout=args.timeout,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Cluster axis model: the (hosts, devices) mesh the dist engines run on.
+
+The multi-host runtime generalizes the flat 1-D ``"peers"`` device mesh to
+a 2-D ``("hosts", "peers")`` mesh whose row-major flattening IS the flat
+shard order: shard ``s`` of the 1-D mesh is device ``(s // D, s % D)`` of
+the (H, D) mesh, ``jax.lax.axis_index(("hosts", "peers"))`` yields the
+same 0..S-1 ids, and a collective over the axis TUPLE executes the same
+SPMD program as the flat collective. That flattening invariant is the
+whole determinism story: a 2-D-mesh round is bit-identical to the flat
+single-host round (and transitively to the local engine where that
+contract holds) because it is literally the same program over the same
+shard ids — tests/sim/test_cluster.py pins it.
+
+Axis semantics (dist/mesh.py AXIS_KINDS): the fast intra-host ``"peers"``
+axis rides ICI, the slow cross-host ``"hosts"`` axis rides DCN. On the
+emulated single-process mesh both axes are host RAM — the 2-D shape is
+still meaningful because the static wire analyses and the hierarchical
+transport (cluster/hier.py) split bytes by axis, and the byte split is
+platform-independent.
+
+This module deliberately imports nothing from the rest of the package so
+``dist/`` can depend on it without cycles.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+__all__ = [
+    "HOST_AXIS",
+    "DEVICE_AXIS",
+    "make_cluster_mesh",
+    "mesh_axes",
+    "mesh_hosts",
+    "global_put",
+]
+
+HOST_AXIS = "hosts"
+DEVICE_AXIS = "peers"
+
+
+def make_cluster_mesh(
+    n_devices: int | None = None, hosts: int = 1
+) -> Mesh:
+    """(hosts, devices) mesh over (the first ``n_devices``) devices.
+
+    ``hosts=1`` returns the flat 1-D ``("peers",)`` mesh the engines have
+    always run on; ``hosts=H`` reshapes the same device order row-major to
+    (H, n/H) with axes ``("hosts", "peers")`` — under ``jax.distributed``
+    each process contributes its local devices as one host row, and the
+    single-process emulation reshapes the emulated devices identically.
+    """
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} available")
+    if hosts <= 1:
+        return Mesh(np.asarray(devs[:n]), (DEVICE_AXIS,))
+    if n % hosts:
+        raise ValueError(
+            f"--hosts {hosts} does not divide the device count {n} — the "
+            f"(hosts, devices) mesh needs equal rows"
+        )
+    return Mesh(
+        np.asarray(devs[:n]).reshape(hosts, n // hosts),
+        (HOST_AXIS, DEVICE_AXIS),
+    )
+
+
+def mesh_axes(mesh: Mesh) -> "str | tuple[str, ...]":
+    """The collective/sharding axis spec of a cluster mesh.
+
+    The flat mesh keeps its single axis name; the 2-D mesh returns the
+    axis TUPLE ``("hosts", "peers")`` — every ``PartitionSpec``,
+    ``all_to_all``, ``psum``/``pmax``, ``all_gather`` and ``axis_index``
+    in the dist engines takes this value verbatim, which is what makes
+    the 2-D program the flat program.
+    """
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
+
+
+def mesh_hosts(mesh: Mesh) -> tuple[int, int]:
+    """(H, D) of a cluster mesh; the flat mesh is (1, S)."""
+    if len(mesh.axis_names) == 1:
+        return 1, mesh.size
+    return mesh.shape[HOST_AXIS], mesh.shape[DEVICE_AXIS]
+
+
+def global_put(x, mesh: Mesh, spec) -> jax.Array:
+    """Place one host value onto the mesh, multi-process included.
+
+    Single-process this is ``jax.device_put`` with the NamedSharding —
+    the path every engine has always taken. Under ``jax.distributed`` the
+    mesh spans devices this process cannot address, so the array is built
+    shard by shard from the host value via
+    ``jax.make_array_from_callback`` instead: every process holds the
+    SAME host value (states are initialized from seeds, tables from the
+    partition — both deterministic), and each contributes exactly its
+    addressable shards.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    dt = getattr(x, "dtype", None)
+    if dt is not None and jax.numpy.issubdtype(dt, jax.dtypes.prng_key):
+        # key arrays can't round-trip through numpy; place the raw key
+        # data (the trailing data dims are never mesh-sharded — key
+        # operands are replicated) and re-wrap
+        data = global_put(jax.random.key_data(x), mesh, spec)
+        return jax.random.wrap_key_data(data, impl=jax.random.key_impl(x))
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )

@@ -54,6 +54,7 @@ __all__ = [
     "message_slot",
     "message_slots",
     "saturate_round",
+    "shard_ranges",
     "zero_suspicion",
     "validate_state_planes",
     "save_swarm",
@@ -79,6 +80,30 @@ def saturate_round(rnd, dtype):
     Comparisons stay at the wide cursor (int32 promotion); only the
     STORED value narrows."""
     return jnp.minimum(rnd, ROUND_CAP).astype(dtype)
+
+
+def shard_ranges(n_shards: int, block: int, mesh=None) -> list[tuple[int, int]]:
+    """Per-shard ``[lo, hi)`` row ranges of the global row-major layout.
+
+    Shard ``s`` owns rows ``[s * block, (s + 1) * block)`` of every global
+    array, where ``s`` is the ROW-MAJOR flat index over the mesh axes. A
+    2-D ``(hosts, devices)`` mesh flattens row-major to the same device
+    order as the flat 1-D mesh, so the ranges are shape-independent — this
+    helper is where that invariant lives: scenario compilation, the
+    checkpoint resharding contract, and the round engines all lean on it
+    together. Pass ``mesh`` to assert the shard count actually matches.
+    """
+    if n_shards < 1 or block < 1:
+        raise ValueError(
+            f"shard_ranges needs n_shards >= 1 and block >= 1, got "
+            f"({n_shards}, {block})"
+        )
+    if mesh is not None and int(mesh.size) != n_shards:
+        raise ValueError(
+            f"mesh has {int(mesh.size)} devices but the layout expects "
+            f"{n_shards} shards"
+        )
+    return [(s * block, (s + 1) * block) for s in range(n_shards)]
 
 
 @dataclasses.dataclass(frozen=True)

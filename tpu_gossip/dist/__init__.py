@@ -1,10 +1,17 @@
-"""Multi-chip execution: peers 1-D sharded over a ``jax.sharding.Mesh``.
+"""Multi-chip execution: peers sharded over a ``jax.sharding.Mesh``.
 
 The reference's "distributed backend" is raw TCP with thread-per-connection
 (SURVEY.md §5.8). Here, cross-node communication is XLA collectives over
 ICI/DCN: the peer axis is sharded across devices, cross-partition edges are
 pre-bucketed by (source shard → destination shard), and a gossip round's
 fan-out is one ``all_to_all`` inside ``shard_map``.
+
+The mesh may be flat 1-D (``make_mesh``) or a 2-D ``(hosts, devices)``
+cluster mesh (``tpu_gossip.cluster.make_cluster_mesh``): collectives run
+over the axis tuple, which flattens row-major to the same shard order, so
+2-D runs are bit-identical to flat. ``build_transport(..., mode="hier")``
+swaps the single compact lane for the two-level ICI/DCN transport in
+``tpu_gossip.cluster.hier``.
 """
 
 from tpu_gossip.dist._compat import shard_map_compat
@@ -18,6 +25,7 @@ from tpu_gossip.dist.mesh import (
     partition_graph,
     build_shard_plans,
     shard_swarm,
+    shard_graph,
     gossip_round_dist,
     simulate_dist,
     run_until_coverage_dist,
@@ -36,6 +44,7 @@ __all__ = [
     "partition_graph",
     "build_shard_plans",
     "shard_swarm",
+    "shard_graph",
     "shard_matching_plan",
     "shard_map_compat",
     "init_sharded_swarm",

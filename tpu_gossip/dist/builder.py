@@ -55,12 +55,11 @@ from tpu_gossip.core.matching_topology import (
     reduce_classes,
     sharded_layout,
 )
+from tpu_gossip.cluster.topology import mesh_axes
 from tpu_gossip.dist._compat import shard_map_compat
 from tpu_gossip.kernels.permute import apply_pipeline, inverse_tables
 
 __all__ = ["matching_powerlaw_graph_dist"]
-
-AXIS = "peers"
 
 
 def matching_powerlaw_graph_dist(
@@ -98,6 +97,7 @@ def matching_powerlaw_graph_dist(
         )
     if growth_rows < 0:
         raise ValueError(f"growth_rows={growth_rows} must be >= 0")
+    axes = mesh_axes(mesh)
 
     # --- host planning: the ONE shared layout law (the conformance
     # contract rests on planning the same layout the local builder does)
@@ -125,19 +125,19 @@ def matching_powerlaw_graph_dist(
         mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(
-            tuple(P(AXIS) for _ in range(n_stages)),  # lanes
-            P(AXIS),  # m3
-            tuple(P(AXIS) for _ in range(n_stages)),  # lanes_inv
-            P(AXIS),  # valid
-            P(AXIS),  # deg_other
-            P(AXIS),  # deg_real (n_state,)
-            P(AXIS),  # row_ptr blocks (n_state,) — total appended outside
-            P(AXIS),  # col_idx (rows*128,)
+            tuple(P(axes) for _ in range(n_stages)),  # lanes
+            P(axes),  # m3
+            tuple(P(axes) for _ in range(n_stages)),  # lanes_inv
+            P(axes),  # valid
+            P(axes),  # deg_other
+            P(axes),  # deg_real (n_state,)
+            P(axes),  # row_ptr blocks (n_state,) — total appended outside
+            P(axes),  # col_idx (rows*128,)
         ),
         check_vma=False,
     )
     def build(kd, deg_b):
-        sh = jax.lax.axis_index(AXIS)
+        sh = jax.lax.axis_index(axes)
         skeys = jax.random.wrap_key_data(kd)
 
         def table(i):
@@ -159,7 +159,7 @@ def matching_powerlaw_graph_dist(
 
         def partner(x):
             return apply_pipeline(
-                x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+                x, stages, interpret=interpret, axis_name=axes, n_shards=s
             )
 
         # --- per-slot plan vectors, block-local --------------------------
@@ -254,7 +254,7 @@ def matching_powerlaw_graph_dist(
             )
         else:
             total = jnp.sum(deg_i32, dtype=jnp.int32)
-            totals = jax.lax.all_gather(total, AXIS)
+            totals = jax.lax.all_gather(total, axes)
             base = jnp.sum(
                 jnp.where(jnp.arange(s) < sh, totals, 0), dtype=jnp.int32
             )
@@ -302,7 +302,7 @@ def matching_powerlaw_graph_dist(
     )
     exists = jax.device_put(
         jnp.asarray((np.arange(n_state) % n_blk) < n_per),
-        NamedSharding(mesh, P(AXIS)),
+        NamedSharding(mesh, P(axes)),
     )
     graph = DeviceGraph(
         row_ptr=row_ptr, col_idx=col_idx, exists=exists, n=n_state - 1

@@ -45,8 +45,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_gossip.cluster.topology import global_put, mesh_axes, mesh_hosts
 from tpu_gossip.core.matching_topology import (
     MatchingPlan,
     expand_classes,
@@ -105,8 +106,11 @@ def shard_matching_plan(plan: MatchingPlan, mesh: Mesh) -> MatchingPlan:
 
     Every (R, 128) table row-shards on the peer axis (shard s's block is
     its ``per_rows`` rows of each stage table); ``deg_real`` (n_state,)
-    shards like the state. One ``device_put`` per array, once per plan —
-    the round path then moves no table bytes at all.
+    shards like the state. One placement per array, once per plan — the
+    round path then moves no table bytes at all. On a 2-D cluster mesh
+    the row axis shards over the axis tuple (the flat shard order), and
+    placement goes through ``cluster.topology.global_put`` so a
+    multi-process mesh builds each process's addressable shards.
     """
     import dataclasses
 
@@ -116,8 +120,7 @@ def shard_matching_plan(plan: MatchingPlan, mesh: Mesh) -> MatchingPlan:
             f"{mesh.size} devices — rebuild with "
             f"matching_powerlaw_graph_sharded(n, {mesh.size})"
         )
-    row = NamedSharding(mesh, P(AXIS))
-    put = functools.partial(jax.device_put, device=row)
+    put = functools.partial(global_put, mesh=mesh, spec=P(mesh_axes(mesh)))
     return dataclasses.replace(
         plan,
         lanes=tuple(put(t) for t in plan.lanes),
@@ -189,6 +192,15 @@ def _matching_exchange_dist(
         transport.check_matches_plan(plan)
         if not transport.active:
             transport = None
+    axes = mesh_axes(mesh)
+    hosts = mesh_hosts(mesh)[0]
+    hier_on = transport is not None and transport.hier
+    if hier_on and transport.hosts != hosts:
+        raise ValueError(
+            f"hier transport built for {transport.hosts} hosts but the mesh "
+            f"has {hosts} host rows — rebuild with build_transport(plan, "
+            f"'hier', hosts={hosts})"
+        )
     s = plan.mesh_shards
     w_count = packed_width(m)
     shape = (plan.rows, 128)
@@ -233,15 +245,15 @@ def _matching_exchange_dist(
             operands.append(pull_needy_rows)
     operands += list(plan.lanes) + [plan.m3] + list(plan.lanes_inv)
     k_stages = len(plan.lanes)
-    in_specs = [P(AXIS)] * len(operands)
+    in_specs = [P(axes)] * len(operands)
     if has_pull_gate:
         # the controller's pull gate is a replicated scalar decision —
         # every shard reads the same value (like the transport hub tables)
         operands.append(jnp.reshape(pull_gate, (1,)))
         in_specs.append(P())
-    if transport is not None:
+    if transport is not None and not hier_on:
         operands.append(transport.leaf_slots)
-        in_specs.append(P(AXIS))
+        in_specs.append(P(axes))
         operands += list(transport.hub_tables)
         # hub tables are tiny and read by sender AND receiver: replicated
         in_specs += [P()] * len(transport.hub_tables)
@@ -250,7 +262,7 @@ def _matching_exchange_dist(
         shard_map_compat,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(AXIS), P(AXIS)),
+        out_specs=(P(axes), P(axes)),
         # lane shuffles and the fold kernel launch pallas_call with
         # shard-varying tables, which the replication checker cannot type
         # (same reason as dist/mesh.py's staircase receive)
@@ -271,15 +283,27 @@ def _matching_exchange_dist(
         m3_blk = next(it)
         lanes_inv_blks = [next(it) for _ in range(k_stages)]
         pg_blk = next(it) if has_pull_gate else None
-        if transport is not None:
+        if transport is not None and not hier_on:
             leaf_blk = next(it)  # (per_rows, 128) bool
             hub_blks = [next(it) for _ in range(len(transport.hub_tables))]
         stages = _local_stages(lane_blks, m3_blk, lanes_inv_blks)
 
         def partner(x):
+            if hier_on:
+                from tpu_gossip.cluster.hier import apply_pipeline_hier
+
+                # ONE conserved nonzero count per pipeline application
+                # bounds every hier stage's host-axis occupancy (occupied
+                # rows never exceed nonzero bytes) — the flat transport's
+                # conservation trick, one level up
+                nz = jax.lax.psum(jnp.sum(x != 0, dtype=jnp.int32), axes)
+                return apply_pipeline_hier(
+                    x, stages, hosts, s, transport.dcn_budget,
+                    nz <= transport.dcn_budget, interpret=interpret,
+                )
             if transport is None:
                 return apply_pipeline(
-                    x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+                    x, stages, interpret=interpret, axis_name=axes, n_shards=s
                 )
             # occupancy header: the plane's (total, leaf-origin) nonzero
             # word counts, psum'd — both conserved by the permutation, so
@@ -291,13 +315,13 @@ def _matching_exchange_dist(
                     jnp.sum(nz, dtype=jnp.int32),
                     jnp.sum(nz & leaf_blk, dtype=jnp.int32),
                 ]),
-                AXIS,
+                axes,
             )
             return apply_pipeline_transport(
                 x, stages, hub_blks, transport.stage_mode,
                 transport.budget, cnts[1] <= transport.budget,
                 cnts[0] <= transport.budget,
-                axis_name=AXIS, n_shards=s, interpret=interpret,
+                axis_name=axes, n_shards=s, interpret=interpret,
             )
 
         msgs = jnp.zeros((), jnp.int32)
@@ -397,6 +421,15 @@ def _matching_flood_dist(
         transport.check_matches_plan(plan)
         if not transport.active:
             transport = None
+    axes = mesh_axes(mesh)
+    hosts = mesh_hosts(mesh)[0]
+    hier_on = transport is not None and transport.hier
+    if hier_on and transport.hosts != hosts:
+        raise ValueError(
+            f"hier transport built for {transport.hosts} hosts but the mesh "
+            f"has {hosts} host rows — rebuild with build_transport(plan, "
+            f"'hier', hosts={hosts})"
+        )
     s = plan.mesh_shards
     w_count = packed_width(m)
     tx_words = (
@@ -410,10 +443,10 @@ def _matching_flood_dist(
         [tx_words, plan.valid] + list(plan.lanes) + [plan.m3]
         + list(plan.lanes_inv)
     )
-    in_specs = [P(AXIS)] * len(operands)
-    if transport is not None:
+    in_specs = [P(axes)] * len(operands)
+    if transport is not None and not hier_on:
         operands.append(transport.leaf_slots)
-        in_specs.append(P(AXIS))
+        in_specs.append(P(axes))
         operands += list(transport.hub_tables)
         in_specs += [P()] * len(transport.hub_tables)
 
@@ -421,7 +454,7 @@ def _matching_flood_dist(
         shard_map_compat,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(AXIS),
+        out_specs=P(axes),
         check_vma=False,
     )
     def ex(*blks):
@@ -432,15 +465,23 @@ def _matching_flood_dist(
         lane_blks = [next(it) for _ in range(k_stages)]
         m3_blk = next(it)
         lanes_inv_blks = [next(it) for _ in range(k_stages)]
-        if transport is not None:
+        if transport is not None and not hier_on:
             leaf_blk = next(it)
             hub_blks = [next(it) for _ in range(len(transport.hub_tables))]
         stages = _local_stages(lane_blks, m3_blk, lanes_inv_blks)
 
         def partner(x):
+            if hier_on:
+                from tpu_gossip.cluster.hier import apply_pipeline_hier
+
+                nz = jax.lax.psum(jnp.sum(x != 0, dtype=jnp.int32), axes)
+                return apply_pipeline_hier(
+                    x, stages, hosts, s, transport.dcn_budget,
+                    nz <= transport.dcn_budget, interpret=interpret,
+                )
             if transport is None:
                 return apply_pipeline(
-                    x, stages, interpret=interpret, axis_name=AXIS, n_shards=s
+                    x, stages, interpret=interpret, axis_name=axes, n_shards=s
                 )
             nz = x != 0
             cnts = jax.lax.psum(
@@ -448,13 +489,13 @@ def _matching_flood_dist(
                     jnp.sum(nz, dtype=jnp.int32),
                     jnp.sum(nz & leaf_blk, dtype=jnp.int32),
                 ]),
-                AXIS,
+                axes,
             )
             return apply_pipeline_transport(
                 x, stages, hub_blks, transport.stage_mode,
                 transport.budget, cnts[1] <= transport.budget,
                 cnts[0] <= transport.budget,
-                axis_name=AXIS, n_shards=s, interpret=interpret,
+                axis_name=axes, n_shards=s, interpret=interpret,
             )
 
         outs = []
@@ -627,7 +668,8 @@ def gossip_round_dist_matching(
         state, cfg, scenario
     )
     return (*out, _ici_matching(state, cfg, plan, transport, tx_eff,
-                                transmitter, receptive))
+                                transmitter, receptive,
+                                hosts=mesh_hosts(mesh)[0]))
 
 
 def _gossip_round_dist_matching_packed(ps, cfg, plan, mesh, scenario, growth,
@@ -728,11 +770,12 @@ def _gossip_round_dist_matching_packed(ps, cfg, plan, mesh, scenario, growth,
     shim = types.SimpleNamespace(seen=unpack_bits(ps.seen, m),
                                  rewired=flags["rewired"])
     return (*out, _ici_matching(shim, cfg, plan, transport,
-                                unpack_bits(tx_w, m), role_b, role_b))
+                                unpack_bits(tx_w, m), role_b, role_b,
+                                hosts=mesh_hosts(mesh)[0]))
 
 
 def _ici_matching(state, cfg, plan, transport, transmit, transmitter,
-                  receptive):
+                  receptive, hosts=1):
     """The analytic counter's view of one matching round: the same plane
     masks ``_disseminate_matching_dist`` feeds the exchange (fault-free
     single-pass model on the effective transmit plane)."""
@@ -741,10 +784,11 @@ def _ici_matching(state, cfg, plan, transport, transmit, transmitter,
 
     if cfg.mode == "flood":
         return ici_round_matching(plan, transport, cfg.msg_slots, transmit,
-                                  None)
+                                  None, hosts=hosts)
     tx, answer, _ = kernel_path_masks(
         state, cfg, transmit, transmitter, receptive
     )
     if cfg.mode != "push_pull":
         answer = None  # the pull direction (and its extra plane) never runs
-    return ici_round_matching(plan, transport, cfg.msg_slots, tx, answer)
+    return ici_round_matching(plan, transport, cfg.msg_slots, tx, answer,
+                              hosts=hosts)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_gossip.cluster.topology import global_put, mesh_axes, mesh_hosts
 from tpu_gossip.core.state import SwarmConfig, SwarmState, init_swarm
 from tpu_gossip.core.topology import Graph, build_csr
 from tpu_gossip.dist._compat import shard_map_compat
@@ -59,6 +60,7 @@ __all__ = [
     "partition_graph",
     "build_shard_plans",
     "shard_swarm",
+    "shard_graph",
     "init_sharded_swarm",
     "repartition_swarm",
     "gossip_round_dist",
@@ -507,16 +509,45 @@ def shard_swarm(state: SwarmState, mesh: Mesh) -> SwarmState:
     1-device mesh, and for replicated leaves on any mesh). The dist round
     entry points donate their state, so callers that keep using the
     UNSHARDED original must shard a ``clone_state`` instead.
+
+    On a 2-D (hosts, devices) cluster mesh the peer axis shards over the
+    axis TUPLE (row-major over hosts then devices — the flat shard
+    order), and placement goes through ``cluster.topology.global_put`` so
+    a multi-process mesh builds each process's addressable shards from
+    the replicated host value.
     """
-    peer = NamedSharding(mesh, P(AXIS))
-    repl = NamedSharding(mesh, P())
+    axes = mesh_axes(mesh)
     n_pad = state.alive.shape[0]
 
     def place(x):
         is_peer_dim = hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n_pad
-        return jax.device_put(x, peer if is_peer_dim else repl)
+        return global_put(x, mesh, P(axes) if is_peer_dim else P())
 
     return jax.tree.map(place, state)
+
+
+def shard_graph(sg: ShardedGraph, mesh: Mesh) -> ShardedGraph:
+    """Place the routing tables on the mesh, multi-process safe.
+
+    Single-process runs never need this — ``shard_map`` accepts unplaced
+    (committed-to-device-0) operands and shards them on entry. Under
+    ``jax.distributed`` every shard_map operand must be a GLOBAL array
+    whose addressable shards this process owns, so the bucket tables (S
+    leading dim) and the per-peer degree vector go through ``global_put``
+    with the peer-axis spec — the same placement ``shard_swarm`` gives the
+    state.
+    """
+    axes = mesh_axes(mesh)
+
+    def place(x):
+        return global_put(x, mesh, P(axes))
+
+    return dataclasses.replace(
+        sg,
+        send_src=place(sg.send_src), recv_dst=place(sg.recv_dst),
+        send_valid=place(sg.send_valid), send_dst_deg=place(sg.send_dst_deg),
+        send_src_deg=place(sg.send_src_deg), deg=place(sg.deg),
+    )
 
 
 def dense_wire_words(
@@ -599,6 +630,14 @@ def _exchange(
     pull gate — same draw shapes, same keys, only thresholds move, so a
     zero-adjustment controller reproduces the uncontrolled exchange bit
     for bit. The decision rides one tiny replicated (S, 2) operand.
+
+    On a 2-D (hosts, devices) mesh the same program runs over the axis
+    TUPLE (bit-identical to the flat mesh — the tuple flattens row-major
+    to the same shard ids); a hier transport replaces the combined-axis
+    ``all_to_all`` with the two-level decomposition
+    (:func:`~tpu_gossip.cluster.hier.bucketed_hier_exchange`), gated on
+    the post-ICI-stage occupancy pmax'd over BOTH axes so the lane choice
+    is replicated — and exact, so hier rounds stay bit-identical too.
     """
     from tpu_gossip.core.packed import (
         pack_bits, packed_width, unpack_bits, words8_to_words32,
@@ -611,6 +650,8 @@ def _exchange(
     s, b = sg.n_shards, sg.bucket
     per = sg.per_shard
     m = transmit.shape[1]
+    axes = mesh_axes(mesh)
+    hosts, _devs = mesh_hosts(mesh)
     groups = _slot_groups(m)  # 32-slot views for the staircase receive
     w_count = packed_width(m)
     has_blocked = blocked_rows is not None
@@ -618,9 +659,16 @@ def _exchange(
         blocked_rows = jnp.zeros(transmit.shape[0], dtype=bool)
     if shard_plan is not None:
         shard_plan.check_matches(sg)
-    sparse_on = transport is not None and transport.active
+    hier_on = transport is not None and transport.hier
+    sparse_on = transport is not None and transport.active and not hier_on
     if transport is not None:
         transport.check_matches_graph(sg)
+    if hier_on and transport.hosts != hosts:
+        raise ValueError(
+            f"hier transport built for {transport.hosts} hosts but the mesh "
+            f"has {hosts} host rows — rebuild with build_transport(sg, "
+            f"'hier', hosts={hosts})"
+        )
     plan_args = () if shard_plan is None else (
         shard_plan.tile_block, shard_plan.first_visit,
         shard_plan.offs, shard_plan.window_idx,
@@ -643,13 +691,14 @@ def _exchange(
     @functools.partial(
         shard_map_compat,
         mesh=mesh,
-        in_specs=(P(AXIS),) * (8 + len(plan_args) + len(ctl_args)),
-        out_specs=(P(AXIS), P(AXIS)),
+        in_specs=(P(axes),) * (8 + len(plan_args) + len(ctl_args)),
+        out_specs=(P(axes), P(axes)),
         # the kernel path launches pallas_call with shard-varying prefetch
         # tables, which the varying-axes checker cannot type (see _launch);
-        # the sparse lane nests collectives under lax.cond on a pmax'd
-        # predicate — replicated control the checker cannot type either
-        check_vma=shard_plan is None and not sparse_on,
+        # the sparse/hier lanes nest collectives under lax.cond on a
+        # pmax'd predicate — replicated control the checker cannot type
+        # either
+        check_vma=shard_plan is None and not sparse_on and not hier_on,
     )
     def ex(transmit_blk, send_src, recv_dst, valid, dst_deg, src_deg, key_blk,
            blocked_blk, *rest):
@@ -701,9 +750,25 @@ def _exchange(
             # per-direction billing rides two bits in one extra byte
             acts = act_p.astype(jnp.uint8) | (act_q.astype(jnp.uint8) << 1)
             payload = jnp.concatenate([payload, acts[:, :, None]], axis=-1)
-        if not sparse_on:
+        if hier_on:
+            from tpu_gossip.cluster.hier import bucketed_hier_exchange
+            from tpu_gossip.cluster.topology import DEVICE_AXIS
+
+            # PRE-activation occupancy (see the sparse lane below); the
+            # device-axis psum yields each post-ICI-stage row's occupancy
+            # (entries from my whole host per destination shard), and the
+            # both-axes pmax replicates the gate — the identical quantity
+            # ici_round_bucketed's hcounts maximum reads.
+            occ = valid & (vals != 0).any(-1)
+            counts = occupancy_counts(occ)  # (S,) — the header row
+            hrow = jax.lax.psum(counts, DEVICE_AXIS)
+            fits = jax.lax.pmax(jnp.max(hrow), axes) <= transport.dcn_budget
+            received = bucketed_hier_exchange(
+                payload, hosts, transport.dcn_budget, fits
+            )
+        elif not sparse_on:
             received = jax.lax.all_to_all(
-                payload, AXIS, split_axis=0, concat_axis=0, tiled=True
+                payload, axes, split_axis=0, concat_axis=0, tiled=True
             )  # received[s'] = bucket shard s' packed for me
         else:
             # PRE-activation occupancy: an entry carries bytes only if its
@@ -719,22 +784,22 @@ def _exchange(
             cap = transport.budget
             # header exchange: one pmax makes the gate identical on every
             # shard, so the cond's collectives stay replicated-control
-            fits = jax.lax.pmax(jnp.max(counts), AXIS) <= cap
+            fits = jax.lax.pmax(jnp.max(counts), axes) <= cap
 
             def compact_lane():
                 idx = compact_index(occ, cap)  # (S, C), sentinel b
                 cvals = gather_compact(payload, idx)  # (S, C, G')
                 idx_r = jax.lax.all_to_all(
-                    idx, AXIS, split_axis=0, concat_axis=0, tiled=True
+                    idx, axes, split_axis=0, concat_axis=0, tiled=True
                 )
                 cvals_r = jax.lax.all_to_all(
-                    cvals, AXIS, split_axis=0, concat_axis=0, tiled=True
+                    cvals, axes, split_axis=0, concat_axis=0, tiled=True
                 )
                 return scatter_compact(idx_r, cvals_r, b)
 
             def dense_lane():
                 return jax.lax.all_to_all(
-                    payload, AXIS, split_axis=0, concat_axis=0, tiled=True
+                    payload, axes, split_axis=0, concat_axis=0, tiled=True
                 )
 
             received = jax.lax.cond(fits, compact_lane, dense_lane)
@@ -1026,7 +1091,7 @@ def gossip_round_dist(
     # schedule too: the issue is what moves bytes this round).
     tx_eff, transmitter, _ = effective_transmit_planes(state, cfg, scenario)
     return (*out, _ici_bucketed(state, cfg, sg, transport, tx_eff,
-                                transmitter))
+                                transmitter, hosts=mesh_hosts(mesh)[0]))
 
 
 def _gossip_round_dist_packed(ps, cfg, sg, mesh, shard_plan, scenario, growth,
@@ -1100,10 +1165,10 @@ def _gossip_round_dist_packed(ps, cfg, sg, mesh, shard_plan, scenario, growth,
             if rewiring:
                 ans_any = ans_any & ~flags["rewired"]
     return (*out, ici_round_bucketed(sg, transport, nbytes, tx_any, ans_any,
-                                     merged))
+                                     merged, hosts=mesh_hosts(mesh)[0]))
 
 
-def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
+def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter, hosts=1):
     """The analytic counter's view of one bucketed round: the same plane
     masks ``_disseminate_bucketed`` applies, reduced to per-row
     nonzero-word indicators."""
@@ -1122,7 +1187,8 @@ def _ici_bucketed(state, cfg, sg, transport, transmit, transmitter):
             ans_any = (state.seen & transmitter).any(-1)
             if rewiring:
                 ans_any = ans_any & ~state.rewired
-    return ici_round_bucketed(sg, transport, nbytes, tx_any, ans_any, merged)
+    return ici_round_bucketed(sg, transport, nbytes, tx_any, ans_any, merged,
+                              hosts=hosts)
 
 
 @functools.partial(

@@ -120,6 +120,17 @@ class Transport:
     # provenance: the bucket layout / plan the tables were built for
     # (sg.fingerprint, or the matching plan's (rows, shards) signature)
     fingerprint: int = dataclasses.field(default=0, metadata=dict(static=True))
+    # hierarchical (two-level) transport: host-row count of the 2-D mesh
+    # the hier stages were compiled for, and the DCN stage's static
+    # compact budget (entries for the bucketed engine, slot rows for the
+    # matching family). mode == "hier" selects the cluster/hier.py stage
+    # decompositions at the call sites.
+    hosts: int = dataclasses.field(default=1, metadata=dict(static=True))
+    dcn_budget: int = dataclasses.field(default=0, metadata=dict(static=True))
+
+    @property
+    def hier(self) -> bool:
+        return self.mode == "hier"
 
     def check_matches_graph(self, sg) -> None:
         if self.engine != "bucketed":
@@ -158,8 +169,9 @@ class Transport:
 
 
 class IciRound(NamedTuple):
-    """One round's analytic ICI accounting, in 4-byte WORDS (scalar int32;
-    bytes = 4x, derived host-side so 10M-scale rounds can't overflow).
+    """One round's analytic wire accounting, in 4-byte WORDS (scalar
+    int32; bytes = 4x, derived host-side so 10M-scale rounds can't
+    overflow).
 
     ``dense_words`` is what the dense transport ships; ``shipped_words``
     what the configured transport ships (static compact-lane shapes +
@@ -170,6 +182,15 @@ class IciRound(NamedTuple):
     compact lane. The model is the fault-free single-pass exchange
     (a partition phase's second delivery pass is not double-billed here —
     this is a transport metric, not a fault metric).
+
+    The ``dcn_*`` columns are the slice of the first two that crosses the
+    HOST axis of a (hosts, devices) mesh (dist/mesh.py AXIS_KINDS); the
+    ICI slice is the difference. On the flat 1-D mesh they are zero; a
+    flat combined-axis collective on a 2-D mesh is priced entirely on the
+    slow axis (the conservative reading the per-axis census takes —
+    docs/multihost_mesh.md); the hierarchical transport bills its dense
+    intra-host stage to ICI and only the compacted host stage to DCN —
+    the hierarchy win these columns exist to track.
     """
 
     dense_words: jax.Array
@@ -177,11 +198,13 @@ class IciRound(NamedTuple):
     occupied_words: jax.Array
     sparse_lanes: jax.Array
     total_lanes: jax.Array
+    dcn_dense_words: jax.Array
+    dcn_shipped_words: jax.Array
 
 
 def zero_ici() -> IciRound:
     z = jnp.zeros((), dtype=jnp.int32)
-    return IciRound(z, z, z, z, z)
+    return IciRound(z, z, z, z, z, z, z)
 
 
 def _add_ici(a: IciRound, b: IciRound) -> IciRound:
@@ -468,6 +491,7 @@ def build_transport(
     compact_frac: float = 0.125,
     hub_rows_frac: float = 1 / 32,
     hub_degree_min: int | None = None,
+    hosts: int = 1,
     mesh=None,
     interpret: bool | None = None,
 ) -> Transport:
@@ -486,20 +510,65 @@ def build_transport(
     hub-row table. ``mode``: "sparse" gates per round on the occupancy
     header alone; "auto" additionally requires the static geometry to
     predict >= 25% byte savings at full budget (otherwise the sparse
-    stages compile out entirely, ``active=False``). "dense" is spelled
+    stages compile out entirely, ``active=False``). "hier" compiles the
+    two-level transport for a (``hosts``, devices) mesh instead: a dense
+    intra-host ICI stage plus an occupancy-compacted cross-host DCN stage
+    (cluster/hier.py) — it replaces the flat compact lane rather than
+    composing with it, so the hub/leaf machinery stays empty and
+    ``dcn_budget`` carries the host-stage entry budget. "dense" is spelled
     ``transport=None`` at the call sites — a Transport always carries the
     sparse machinery.
     """
-    if mode not in ("sparse", "auto"):
-        raise ValueError(f"transport mode {mode!r} must be sparse or auto")
+    if mode not in ("sparse", "auto", "hier"):
+        raise ValueError(
+            f"transport mode {mode!r} must be sparse, auto, or hier"
+        )
     from tpu_gossip.core.matching_topology import MatchingPlan
 
+    if mode == "hier":
+        return _build_hier_transport(target, compact_frac, hosts)
     if isinstance(target, MatchingPlan):
         return _build_matching_transport(
             target, mode, compact_frac, hub_rows_frac, hub_degree_min,
             mesh=mesh, interpret=interpret,
         )
     return _build_bucketed_transport(target, mode, compact_frac)
+
+
+def _build_hier_transport(target, compact_frac: float, hosts: int) -> Transport:
+    from tpu_gossip.core.matching_topology import MatchingPlan
+
+    if hosts <= 1:
+        raise ValueError(
+            "transport mode 'hier' needs a (hosts, devices) mesh — pass "
+            "hosts > 1 (the flat mesh has no DCN axis to compact)"
+        )
+    if isinstance(target, MatchingPlan):
+        s, per = target.mesh_shards, target.per_rows
+        if s % hosts:
+            raise ValueError(
+                f"hier transport: hosts={hosts} does not divide the "
+                f"{s}-shard mesh"
+            )
+        cap = min(max(1, per - 1), max(8, int(math.ceil(per * compact_frac))))
+        return Transport(
+            engine="matching", mode="hier", active=True, budget=cap,
+            n_shards=s, fingerprint=target.rows,
+            hosts=hosts, dcn_budget=cap,
+        )
+    sg = target
+    if sg.n_shards % hosts:
+        raise ValueError(
+            f"hier transport: hosts={hosts} does not divide the "
+            f"{sg.n_shards}-shard mesh"
+        )
+    db = (sg.n_shards // hosts) * sg.bucket
+    cap = max(8, min(db, int(math.ceil(db * compact_frac))))
+    return Transport(
+        engine="bucketed", mode="hier", active=True, budget=cap,
+        n_shards=sg.n_shards, fingerprint=sg.fingerprint,
+        hosts=hosts, dcn_budget=cap,
+    )
 
 
 def _build_bucketed_transport(sg, mode: str, compact_frac: float) -> Transport:
@@ -622,13 +691,12 @@ def _build_matching_transport(
 
     hub_tables = tuple(jnp.asarray(t) for t in tables)
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
 
-        from tpu_gossip.dist.mesh import AXIS
+        from tpu_gossip.cluster.topology import global_put, mesh_axes
 
-        leaf_slots = jax.device_put(leaf_slots, NamedSharding(mesh, P(AXIS)))
-        repl = NamedSharding(mesh, P())
-        hub_tables = tuple(jax.device_put(t, repl) for t in hub_tables)
+        leaf_slots = global_put(leaf_slots, mesh, P(mesh_axes(mesh)))
+        hub_tables = tuple(global_put(t, mesh, P()) for t in hub_tables)
     return Transport(
         leaf_slots=leaf_slots,
         hub_tables=hub_tables,
@@ -669,7 +737,7 @@ def matching_dense_stage_words(rows: int) -> int:
 
 def ici_round_bucketed(
     sg, transport: "Transport | None", nbytes: int, tx_any: jax.Array,
-    ans_any: jax.Array | None, merged: bool,
+    ans_any: jax.Array | None, merged: bool, hosts: int = 1,
 ) -> IciRound:
     """Analytic ICI words for one bucketed round (fault-free model).
 
@@ -684,24 +752,56 @@ def ici_round_bucketed(
     ships one int32 index word per slot plus the uint8 payload rounded up
     to whole words per shard — mirroring ``gather_compact``'s traced
     operands.
+
+    ``hosts`` is the host-row count of the mesh the round runs on (1 on
+    the flat mesh). On a 2-D mesh a flat exchange is priced entirely on
+    the slow axis (``dcn_* = `` the whole wire); a hier transport bills
+    its dense intra-host stage to ICI and the DCN columns track the
+    host-stage of :func:`~tpu_gossip.cluster.hier.bucketed_hier_exchange`
+    — per device an (H, cap) int32 index plane plus the (H, cap, nb)
+    compacted payload, dense fallback + header otherwise — gated on the
+    same post-ICI-stage (src_h, dst_h, dst_d) occupancy the runtime
+    pmax's, so ``dense_words`` under hier is the HONEST 2x (both stages
+    dense).
     """
     s, b, per = sg.n_shards, sg.bucket, sg.per_shard
     srcg = sg.send_src + (jnp.arange(s, dtype=jnp.int32) * per)[:, None, None]
+    hier = transport is not None and transport.hier
 
     def one(plane_any, nb):
         occ = sg.send_valid & plane_any[srcg]
         counts = jnp.sum(occ, axis=-1, dtype=jnp.int32)  # (S, S)
         dense = jnp.int32(bucketed_dense_exchange_words(s, b, nb))
         occupied = (jnp.sum(counts) * nb + 3) // 4
+        z = jnp.int32(0)
+        if hier:
+            h = transport.hosts
+            d = s // h
+            cap = transport.dcn_budget
+            # post-ICI-stage occupancy: entries from host src_h bound for
+            # (dst_h, dst_d), summed over source device and bucket slot
+            hcounts = jnp.sum(
+                occ.reshape(h, d, h, d, b), axis=(1, 4), dtype=jnp.int32
+            )
+            fit = jnp.max(hcounts) <= cap
+            header = jnp.int32(s * h)
+            compact = jnp.int32(s * h * cap + s * (-(-(h * cap * nb) // 4)))
+            dcn_shipped = jnp.where(fit, compact + header, dense + header)
+            return IciRound(
+                dense + dense, dense + dcn_shipped, occupied,
+                fit.astype(jnp.int32), jnp.int32(1), dense, dcn_shipped,
+            )
         if transport is None or not transport.active:
-            return IciRound(dense, dense, occupied, jnp.int32(0), jnp.int32(0))
+            dd = dense if hosts > 1 else z
+            return IciRound(dense, dense, occupied, z, z, dd, dd)
         cap = transport.budget
         header = jnp.int32(s * s)
         fit = jnp.max(counts) <= cap
         compact = jnp.int32(s * s * cap + s * (-(-(s * cap * nb) // 4)))
         shipped = jnp.where(fit, compact + header, dense + header)
         return IciRound(
-            dense, shipped, occupied, fit.astype(jnp.int32), jnp.int32(1)
+            dense, shipped, occupied, fit.astype(jnp.int32), jnp.int32(1),
+            dense if hosts > 1 else z, shipped if hosts > 1 else z,
         )
 
     out = one(tx_any, nbytes + 1 if merged else nbytes)
@@ -712,7 +812,7 @@ def ici_round_bucketed(
 
 def ici_round_matching(
     plan, transport: "Transport | None", m: int, tx: jax.Array,
-    answer: jax.Array | None,
+    answer: jax.Array | None, hosts: int = 1,
 ) -> IciRound:
     """Analytic ICI words for one matching round's transpose passes.
 
@@ -729,6 +829,16 @@ def ici_round_matching(
     (per, 128) uint8 block) — so the compact lane charges
     S x ((H + cap) x 128) payload bytes plus the S x (S, cap) int32 index
     planes.
+
+    ``hosts`` is the host-row count of the mesh (1 on the flat mesh). A
+    flat pipeline on a 2-D mesh prices its whole wire on the slow axis
+    (``dcn_* = `` everything); a hier transport's ICI columns bill the
+    always-dense device-axis stage and the DCN columns track the
+    host-axis stage of each :func:`~tpu_gossip.cluster.hier.
+    transpose_pass_hier` — per shard the compacted (cap, 128) uint8
+    payload plus an (H, cap)-shaped int32 index plane, dense fallback
+    otherwise — gated per group on the one conserved nonzero count the
+    runtime psums (so the header is S words, not 2S).
     """
     from tpu_gossip.core.matching_topology import expand_classes
 
@@ -736,7 +846,8 @@ def ici_round_matching(
     s = plan.mesh_shards
     per = r // s
     groups = [(lo, min(8, m - lo)) for lo in range(0, m, 8)]
-    if transport is not None and transport.active:
+    hier = transport is not None and transport.hier
+    if transport is not None and transport.active and not hier:
         n_stages = len(transport.hub_tables)
         hub_rows = tuple(t.shape[1] for t in transport.hub_tables)
         stage_mode = transport.stage_mode
@@ -754,10 +865,27 @@ def ici_round_matching(
             nz = jnp.sum(slots, dtype=jnp.int32)
             dense = dense_stage * n_stages
             occupied = (nz * n_stages + 3) // 4
+            z = jnp.int32(0)
+            if hier:
+                h = transport.hosts
+                hcap = transport.dcn_budget
+                take = nz <= hcap
+                compact = jnp.int32(s * hcap * 32 + s * h * hcap)
+                dcn_shipped = (
+                    jnp.int32(n_stages) * jnp.where(take, compact, dense_stage)
+                    + jnp.int32(s)  # the psum'd count header
+                )
+                total = _add_ici(total, IciRound(
+                    dense + dense, dense + dcn_shipped, occupied,
+                    take.astype(jnp.int32) * n_stages, jnp.int32(n_stages),
+                    dense, dcn_shipped,
+                ))
+                continue
             if transport is None or not transport.active:
+                dd = dense if hosts > 1 else z
                 total = _add_ici(
                     total,
-                    IciRound(dense, dense, occupied, jnp.int32(0), jnp.int32(0)),
+                    IciRound(dense, dense, occupied, z, z, dd, dd),
                 )
                 continue
             take_leaf = jnp.sum(slots * leaf, dtype=jnp.int32) <= cap
@@ -777,6 +905,7 @@ def ici_round_matching(
             shipped = shipped + jnp.int32(2 * s)  # the psum'd count header
             total = _add_ici(total, IciRound(
                 dense, shipped, occupied, taken, jnp.int32(lanes),
+                dense if hosts > 1 else z, shipped if hosts > 1 else z,
             ))
         return total
 
